@@ -1,0 +1,17 @@
+"""accord-tpu: a TPU-native framework with the capabilities of cassandra-accord.
+
+A ground-up implementation of the Accord consensus protocol (CEP-15: leaderless,
+strict-serializable, multi-key/multi-range distributed transactions with a
+single-WAN-round-trip fast path), re-designed TPU-first:
+
+- host tier: protocol engine (coordination, messages, topology, local state machine,
+  progress/recovery) in Python, mirroring the reference's layer map (SURVEY.md §1);
+- device tier: JAX/XLA/Pallas batched backends for the two compute cores — per-key
+  conflict-index dependency calculation and execution-order wavefront resolution
+  (reference hot loops: accord/local/CommandsForKey.java:614-650,
+  accord/local/Command.java:1294-1643) — see `accord_tpu.ops` / `accord_tpu.models`;
+- native tier: C++ kernels for the sorted-array/CSR structures (reference
+  accord/utils/SortedArrays.java, RelationMultiMap.java) in `native/`.
+"""
+
+__version__ = "0.1.0"

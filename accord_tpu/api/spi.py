@@ -1,0 +1,233 @@
+"""Host integration interfaces (reference: accord/api/*.java — SURVEY.md §2.1)."""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING, Callable, Optional
+
+from accord_tpu.utils.async_chains import AsyncResult
+
+if TYPE_CHECKING:
+    from accord_tpu.primitives.keys import Ranges
+    from accord_tpu.primitives.timestamp import Timestamp, TxnId
+    from accord_tpu.primitives.txn import Txn
+
+
+class Agent(abc.ABC):
+    """Host callback facade (reference api/Agent.java:51-119)."""
+
+    def on_recover(self, node, success, fail) -> None:
+        """Outcome of a locally-initiated recovery."""
+
+    def on_inconsistent_timestamp(self, command, prev: "Timestamp",
+                                  next_: "Timestamp") -> None:
+        raise AssertionError(
+            f"inconsistent timestamp: {prev} vs {next_} for {command}")
+
+    def on_failed_bootstrap(self, phase: str, ranges: "Ranges",
+                            retry: Callable[[], None], failure: BaseException) -> None:
+        retry()
+
+    def on_stale(self, stale_since: "Timestamp", ranges: "Ranges") -> None:
+        """Replica has missed GC'd history for `ranges` and must re-bootstrap."""
+
+    @abc.abstractmethod
+    def on_uncaught_exception(self, failure: BaseException) -> None:
+        ...
+
+    def on_handled_exception(self, failure: BaseException) -> None:
+        pass
+
+    def pre_accept_timeout(self) -> float:
+        """Seconds a coordinator waits for PreAccept before invalidating."""
+        return 1.0
+
+    def expires_at(self, now: float) -> float:
+        return now + self.pre_accept_timeout()
+
+    @abc.abstractmethod
+    def empty_txn(self, kind, keys_or_ranges) -> "Txn":
+        """Factory for deps-only txns (sync points, bootstrap markers)."""
+
+    def metrics_listener(self) -> "EventsListener":
+        return EventsListener()
+
+
+class MessageSink(abc.ABC):
+    """Outbound network port (reference api/MessageSink.java:46-52)."""
+
+    @abc.abstractmethod
+    def send(self, to: int, request) -> None:
+        ...
+
+    @abc.abstractmethod
+    def send_with_callback(self, to: int, request, callback, executor=None) -> None:
+        """Register `callback` (Callback protocol: on_success/on_failure/
+        on_callback_failure) for the reply; executor pins delivery thread
+        affinity (a CommandStore in the reference)."""
+
+    @abc.abstractmethod
+    def reply(self, to: int, reply_context, reply) -> None:
+        ...
+
+
+class EpochReady:
+    """Four-phase epoch readiness (reference api/ConfigurationService.EpochReady):
+    metadata -> coordination -> data -> reads, each an AsyncResult."""
+
+    __slots__ = ("epoch", "metadata", "coordination", "data", "reads")
+
+    def __init__(self, epoch: int, metadata: AsyncResult = None,
+                 coordination: AsyncResult = None, data: AsyncResult = None,
+                 reads: AsyncResult = None):
+        from accord_tpu.utils.async_chains import success
+        self.epoch = epoch
+        self.metadata = metadata or success()
+        self.coordination = coordination or success()
+        self.data = data or success()
+        self.reads = reads or success()
+
+    @classmethod
+    def done(cls, epoch: int) -> "EpochReady":
+        return cls(epoch)
+
+
+class ConfigurationService(abc.ABC):
+    """Epoch/topology feed (reference api/ConfigurationService.java)."""
+
+    @abc.abstractmethod
+    def current_topology(self):
+        ...
+
+    @abc.abstractmethod
+    def get_topology_for_epoch(self, epoch: int):
+        ...
+
+    @abc.abstractmethod
+    def fetch_topology_for_epoch(self, epoch: int) -> None:
+        """Ask the host to fetch an unknown epoch; listeners fire on arrival."""
+
+    @abc.abstractmethod
+    def acknowledge_epoch(self, ready: EpochReady, start_sync: bool = True) -> None:
+        ...
+
+    @abc.abstractmethod
+    def register_listener(self, listener) -> None:
+        """listener.on_topology_update(topology, start_sync) -> AsyncResult"""
+
+
+class DataStore(abc.ABC):
+    """Storage port incl. the bootstrap fetch protocol
+    (reference api/DataStore.java:39-113)."""
+
+    class FetchResult(AsyncResult):
+        """AsyncResult[Ranges] of successfully fetched ranges; abort() cancels."""
+
+        def abort(self) -> None:
+            pass
+
+    class FetchRanges(abc.ABC):
+        """Callbacks the store invokes as it makes ranges durable locally."""
+
+        @abc.abstractmethod
+        def starting(self, ranges: "Ranges"):
+            """Returns a StartingRangeFetch token with started()/cancel()."""
+
+        @abc.abstractmethod
+        def fetched(self, ranges: "Ranges") -> None:
+            ...
+
+        @abc.abstractmethod
+        def fail(self, ranges: "Ranges", failure: BaseException) -> None:
+            ...
+
+    def fetch(self, node, safe_store, ranges: "Ranges", sync_point,
+              fetch_ranges: "DataStore.FetchRanges") -> "DataStore.FetchResult":
+        """Copy `ranges` from peers up to `sync_point`; default: nothing to copy
+        (in-memory hosts snapshot via the apply stream)."""
+        result = DataStore.FetchResult()
+        fetch_ranges.fetched(ranges)
+        result.set_success(ranges)
+        return result
+
+
+class ProgressLog(abc.ABC):
+    """Per-CommandStore liveness driver (reference api/ProgressLog.java:30-59).
+
+    The local state machine notifies phase entry/exit; the implementation owns
+    timeouts and escalates to recovery (accord_tpu.impl.progress_log)."""
+
+    def update(self, store, txn_id: "TxnId", command) -> None:
+        """Command state changed."""
+
+    def waiting(self, blocked_by: "TxnId", store, blocked_until: str,
+                route, participants) -> None:
+        """A local command is blocked on `blocked_by` reaching `blocked_until`
+        ('HasRoute'|'Committed'|'Applied')."""
+
+    def durable(self, command) -> None:
+        ...
+
+    def clear(self, txn_id: "TxnId") -> None:
+        ...
+
+
+class Scheduler(abc.ABC):
+    """Timer port (reference api/Scheduler.java:26-59)."""
+
+    class Scheduled:
+        def cancel(self) -> None:  # pragma: no cover - interface default
+            ...
+
+    @abc.abstractmethod
+    def once(self, delay_s: float, fn: Callable[[], None]) -> "Scheduler.Scheduled":
+        ...
+
+    @abc.abstractmethod
+    def recurring(self, delay_s: float, fn: Callable[[], None]) -> "Scheduler.Scheduled":
+        ...
+
+    @abc.abstractmethod
+    def now(self, fn: Callable[[], None]) -> None:
+        ...
+
+
+class TopologySorter(abc.ABC):
+    """Replica contact-preference ordering (reference api/TopologySorter.java)."""
+
+    @abc.abstractmethod
+    def compare(self, a: int, b: int, shards) -> int:
+        ...
+
+    def sort(self, nodes, shards) -> list:
+        import functools
+        return sorted(nodes, key=functools.cmp_to_key(
+            lambda a, b: self.compare(a, b, shards)))
+
+
+class EventsListener:
+    """Metric hooks (reference api/EventsListener.java:28-68). All optional."""
+
+    def on_committed(self, command) -> None: ...
+    def on_stable(self, command) -> None: ...
+    def on_executed(self, command) -> None: ...
+    def on_applied(self, command, apply_start_ns: int = 0) -> None: ...
+    def on_fast_path_taken(self, txn_id, deps=None) -> None: ...
+    def on_slow_path_taken(self, txn_id, deps=None) -> None: ...
+    def on_recover(self, txn_id, outcome=None) -> None: ...
+    def on_preempted(self, txn_id) -> None: ...
+    def on_timeout(self, txn_id) -> None: ...
+    def on_invalidated(self, txn_id) -> None: ...
+    def on_progress_log_size_change(self, txn_id, delta: int) -> None: ...
+
+
+class LocalConfig:
+    """Tunables (reference config/LocalConfig.java:23-30)."""
+
+    progress_log_schedule_delay_s: float = 0.2
+    epoch_await_timeout_s: float = 30.0
+    command_store_shard_count: int = 8
+
+    @classmethod
+    def default(cls) -> "LocalConfig":
+        return cls()

@@ -1,0 +1,11 @@
+"""Protocol data model (reference: accord/primitives — SURVEY.md §2.2)."""
+
+from accord_tpu.primitives.timestamp import (
+    Timestamp, TxnId, Ballot, TxnKind, Domain, KindSet,
+)
+from accord_tpu.primitives.keys import (
+    RoutingKey, Key, Keys, RoutingKeys, Range, Ranges, Route,
+)
+from accord_tpu.primitives.deps import KeyDeps, RangeDeps, Deps
+from accord_tpu.primitives.txn import Txn, PartialTxn
+from accord_tpu.primitives.writes import Writes

@@ -1,0 +1,426 @@
+"""The Routables type family: keys, ranges, routes.
+
+Reference: accord/primitives/Routables.java:35, Seekables.java, Unseekables.java,
+Route.java:25, AbstractKeys.java, AbstractRanges.java, Range.java, and the api
+key model (accord/api/Key.java:28, RoutingKey.java:26).
+
+Two domains — KEY and RANGE — and two roles: *seekable* (data-addressing: Key,
+Ranges used by the data plane) vs *unseekable* (position-only routing). Our
+keys carry an integer token (the position); hosts may subclass Key to attach
+richer identity, exactly as C* does with its partition keys.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from accord_tpu.utils import invariants
+from accord_tpu.utils.sorted_arrays import (
+    find_ceil, linear_intersection, linear_subtract, linear_union,
+)
+
+
+class RoutingKey:
+    """Position-only key (unseekable): orders by token. Reference RoutingKey.java:26."""
+
+    __slots__ = ("token",)
+
+    def __init__(self, token: int):
+        self.token = token
+
+    def __lt__(self, other): return self.token < other.token
+    def __le__(self, other): return self.token <= other.token
+    def __gt__(self, other): return self.token > other.token
+    def __ge__(self, other): return self.token >= other.token
+
+    def __eq__(self, other):
+        return isinstance(other, RoutingKey) and self.token == other.token
+
+    def __hash__(self):
+        return hash(self.token)
+
+    def __repr__(self):
+        return f"k{self.token}"
+
+    def as_routing(self) -> "RoutingKey":
+        return RoutingKey(self.token)
+
+
+class Key(RoutingKey):
+    """Data key (seekable). Hosts subclass to attach payload identity.
+    Reference api/Key.java:28 (Key extends Seekable, RoutableKey)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return f"K{self.token}"
+
+
+class _SortedKeyList:
+    """Base for Keys/RoutingKeys: immutable sorted unique key sequence."""
+
+    __slots__ = ("_keys",)
+    _elem = RoutingKey
+
+    def __init__(self, keys: Iterable[RoutingKey] = (), _presorted: bool = False):
+        ks = list(keys)
+        if not _presorted:
+            ks = sorted(set(ks), key=lambda k: k.token)
+        self._keys: Tuple[RoutingKey, ...] = tuple(ks)
+
+    # -- sequence protocol --
+    def __len__(self): return len(self._keys)
+    def __iter__(self) -> Iterator[RoutingKey]: return iter(self._keys)
+    def __getitem__(self, i): return self._keys[i]
+    def __bool__(self): return bool(self._keys)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._keys == other._keys
+
+    def __hash__(self):
+        return hash(self._keys)
+
+    def __repr__(self):
+        return f"{type(self).__name__}{list(self._keys)!r}"
+
+    @property
+    def is_empty(self) -> bool:
+        return not self._keys
+
+    def tokens(self) -> List[int]:
+        return [k.token for k in self._keys]
+
+    def contains(self, key: RoutingKey) -> bool:
+        i = find_ceil(self._keys, key)
+        return i < len(self._keys) and self._keys[i] == key
+
+    def index_of(self, key: RoutingKey) -> int:
+        i = find_ceil(self._keys, key)
+        return i if i < len(self._keys) and self._keys[i] == key else -(i + 1) - 0 - 1
+
+    def find(self, key: RoutingKey) -> int:
+        """Index of key, or -(insertion)-1."""
+        i = find_ceil(self._keys, key)
+        if i < len(self._keys) and self._keys[i] == key:
+            return i
+        return -(i + 1)
+
+    # -- set algebra (sorted merges) --
+    def with_(self, other: "_SortedKeyList") -> "_SortedKeyList":
+        return type(self)(linear_union(self._keys, other._keys), _presorted=True)
+
+    def intersecting(self, other: "_SortedKeyList") -> "_SortedKeyList":
+        return type(self)(linear_intersection(self._keys, other._keys), _presorted=True)
+
+    def subtract(self, other: "_SortedKeyList") -> "_SortedKeyList":
+        return type(self)(linear_subtract(self._keys, other._keys), _presorted=True)
+
+    def slice(self, ranges: "Ranges") -> "_SortedKeyList":
+        out: List[RoutingKey] = []
+        for r in ranges:
+            lo = find_ceil(self._keys, RoutingKey(r.start))
+            hi = find_ceil(self._keys, RoutingKey(r.end))
+            out.extend(self._keys[lo:hi])
+        return type(self)(out, _presorted=True)
+
+    def intersects_ranges(self, ranges: "Ranges") -> bool:
+        for r in ranges:
+            lo = find_ceil(self._keys, RoutingKey(r.start))
+            if lo < len(self._keys) and self._keys[lo].token < r.end:
+                return True
+        return False
+
+    def foldl(self, fn: Callable, acc):
+        for k in self._keys:
+            acc = fn(acc, k)
+        return acc
+
+    def to_ranges(self) -> "Ranges":
+        """Minimal covering Ranges: one unit range per key."""
+        return Ranges([Range(k.token, k.token + 1) for k in self._keys])
+
+
+class Keys(_SortedKeyList):
+    """Sorted unique data keys (seekable). Reference primitives/Keys.java."""
+    _elem = Key
+
+    def __init__(self, keys: Iterable[Key] = (), _presorted: bool = False):
+        super().__init__(keys, _presorted=_presorted)
+
+    @classmethod
+    def of(cls, *tokens: int) -> "Keys":
+        return cls([Key(t) for t in tokens])
+
+    def as_routing(self) -> "RoutingKeys":
+        return RoutingKeys([RoutingKey(k.token) for k in self._keys], _presorted=True)
+
+
+class RoutingKeys(_SortedKeyList):
+    """Sorted unique routing keys (unseekable). Reference primitives/RoutingKeys.java."""
+
+    @classmethod
+    def of(cls, *tokens: int) -> "RoutingKeys":
+        return cls([RoutingKey(t) for t in tokens])
+
+    def as_routing(self) -> "RoutingKeys":
+        return self
+
+
+EMPTY_KEYS = Keys(())
+
+
+class Range:
+    """Half-open token range [start, end). Reference primitives/Range.java
+    (the reference supports both end-inclusive/exclusive variants; we fix
+    start-inclusive/end-exclusive, which is the variant its tests exercise)."""
+
+    __slots__ = ("start", "end")
+
+    def __init__(self, start: int, end: int):
+        invariants.check_argument(start < end, "range start must precede end")
+        self.start = start
+        self.end = end
+
+    def contains(self, key: RoutingKey) -> bool:
+        return self.start <= key.token < self.end
+
+    def contains_token(self, token: int) -> bool:
+        return self.start <= token < self.end
+
+    def intersects(self, other: "Range") -> bool:
+        return self.start < other.end and other.start < self.end
+
+    def contains_range(self, other: "Range") -> bool:
+        return self.start <= other.start and other.end <= self.end
+
+    def intersection(self, other: "Range") -> Optional["Range"]:
+        s, e = max(self.start, other.start), min(self.end, other.end)
+        return Range(s, e) if s < e else None
+
+    def _key(self):
+        return (self.start, self.end)
+
+    def __lt__(self, other): return self._key() < other._key()
+    def __le__(self, other): return self._key() <= other._key()
+
+    def __eq__(self, other):
+        return isinstance(other, Range) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return f"[{self.start},{self.end})"
+
+
+class Ranges:
+    """Sorted, deoverlapped range set. Reference primitives/Ranges.java /
+    AbstractRanges.java."""
+
+    __slots__ = ("_ranges",)
+
+    def __init__(self, ranges: Iterable[Range] = (), _normalized: bool = False):
+        rs = list(ranges)
+        if not _normalized:
+            rs = self._normalize(rs)
+        self._ranges: Tuple[Range, ...] = tuple(rs)
+
+    @staticmethod
+    def _normalize(rs: List[Range]) -> List[Range]:
+        if not rs:
+            return []
+        rs = sorted(rs, key=lambda r: (r.start, r.end))
+        out = [rs[0]]
+        for r in rs[1:]:
+            last = out[-1]
+            if r.start <= last.end:  # overlap or adjacency -> merge
+                if r.end > last.end:
+                    out[-1] = Range(last.start, r.end)
+            else:
+                out.append(r)
+        return out
+
+    @classmethod
+    def of(cls, *pairs: Tuple[int, int]) -> "Ranges":
+        return cls([Range(s, e) for s, e in pairs])
+
+    @classmethod
+    def single(cls, start: int, end: int) -> "Ranges":
+        return cls([Range(start, end)])
+
+    EMPTY: "Ranges"
+
+    def __len__(self): return len(self._ranges)
+    def __iter__(self) -> Iterator[Range]: return iter(self._ranges)
+    def __getitem__(self, i): return self._ranges[i]
+    def __bool__(self): return bool(self._ranges)
+
+    def __eq__(self, other):
+        return isinstance(other, Ranges) and self._ranges == other._ranges
+
+    def __hash__(self):
+        return hash(self._ranges)
+
+    def __repr__(self):
+        return f"Ranges{list(self._ranges)!r}"
+
+    @property
+    def is_empty(self) -> bool:
+        return not self._ranges
+
+    def contains(self, key: RoutingKey) -> bool:
+        return self._find_containing(key.token) is not None
+
+    def contains_token(self, token: int) -> bool:
+        return self._find_containing(token) is not None
+
+    def _find_containing(self, token: int) -> Optional[Range]:
+        starts = [r.start for r in self._ranges]
+        i = bisect.bisect_right(starts, token) - 1
+        if i >= 0 and self._ranges[i].contains_token(token):
+            return self._ranges[i]
+        return None
+
+    def intersects(self, other) -> bool:
+        if isinstance(other, Ranges):
+            i = j = 0
+            while i < len(self._ranges) and j < len(other._ranges):
+                a, b = self._ranges[i], other._ranges[j]
+                if a.intersects(b):
+                    return True
+                if a.end <= b.start:
+                    i += 1
+                else:
+                    j += 1
+            return False
+        if isinstance(other, _SortedKeyList):
+            return other.intersects_ranges(self)
+        if isinstance(other, Range):
+            return any(r.intersects(other) for r in self._ranges)
+        raise TypeError(type(other))
+
+    def intersection(self, other: "Ranges") -> "Ranges":
+        out: List[Range] = []
+        i = j = 0
+        while i < len(self._ranges) and j < len(other._ranges):
+            a, b = self._ranges[i], other._ranges[j]
+            x = a.intersection(b)
+            if x is not None:
+                out.append(x)
+            if a.end <= b.end:
+                i += 1
+            else:
+                j += 1
+        return Ranges(out, _normalized=True)
+
+    # intersection is slicing for ranges
+    slice = intersection
+
+    def union(self, other: "Ranges") -> "Ranges":
+        return Ranges(list(self._ranges) + list(other._ranges))
+
+    def subtract(self, other: "Ranges") -> "Ranges":
+        out: List[Range] = []
+        for a in self._ranges:
+            pieces = [a]
+            for b in other._ranges:
+                nxt: List[Range] = []
+                for p in pieces:
+                    if not p.intersects(b):
+                        nxt.append(p)
+                        continue
+                    if p.start < b.start:
+                        nxt.append(Range(p.start, b.start))
+                    if b.end < p.end:
+                        nxt.append(Range(b.end, p.end))
+                pieces = nxt
+            out.extend(pieces)
+        return Ranges(out)
+
+    def contains_all_keys(self, keys: _SortedKeyList) -> bool:
+        return all(self.contains(k) for k in keys)
+
+    def contains_all_ranges(self, other: "Ranges") -> bool:
+        return other.subtract(self).is_empty
+
+
+Ranges.EMPTY = Ranges(())
+
+
+class Route:
+    """Routing cover for a transaction: participating routing keys + the home
+    key (the shard that owns coordination/recovery responsibility).
+
+    Reference: primitives/Route.java:25 (FullKeyRoute/PartialKeyRoute/
+    FullRangeRoute/PartialRangeRoute). We model key- and range-domain routes
+    with one class carrying either keys or ranges; `is_full` marks whether it
+    covers the whole transaction (a Full route) or a shard slice (Partial).
+    """
+
+    __slots__ = ("home_key", "keys", "ranges", "is_full")
+
+    def __init__(self, home_key: RoutingKey, keys: Optional[RoutingKeys] = None,
+                 ranges: Optional[Ranges] = None, is_full: bool = True):
+        invariants.check_argument((keys is None) != (ranges is None),
+                                  "route holds keys xor ranges")
+        self.home_key = home_key
+        self.keys = keys
+        self.ranges = ranges
+        self.is_full = is_full
+
+    @classmethod
+    def of_keys(cls, home_key: RoutingKey, keys: RoutingKeys) -> "Route":
+        return cls(home_key, keys=keys)
+
+    @classmethod
+    def of_ranges(cls, home_key: RoutingKey, ranges: Ranges) -> "Route":
+        return cls(home_key, ranges=ranges)
+
+    @property
+    def is_key_domain(self) -> bool:
+        return self.keys is not None
+
+    def participants(self):
+        return self.keys if self.keys is not None else self.ranges
+
+    def covering(self) -> Ranges:
+        """Minimal Ranges covering the participants."""
+        if self.ranges is not None:
+            return self.ranges
+        return Ranges([Range(k.token, k.token + 1) for k in self.keys])
+
+    def slice(self, ranges: Ranges) -> "Route":
+        if self.keys is not None:
+            return Route(self.home_key, keys=self.keys.slice(ranges), is_full=False)
+        return Route(self.home_key, ranges=self.ranges.slice(ranges), is_full=False)
+
+    def with_(self, other: "Route") -> "Route":
+        invariants.check_argument(other.home_key == self.home_key, "home key mismatch")
+        if self.keys is not None:
+            return Route(self.home_key, keys=self.keys.with_(other.keys),
+                         is_full=self.is_full or other.is_full)
+        return Route(self.home_key, ranges=self.ranges.union(other.ranges),
+                     is_full=self.is_full or other.is_full)
+
+    def intersects(self, ranges: Ranges) -> bool:
+        if self.keys is not None:
+            return self.keys.intersects_ranges(ranges)
+        return self.ranges.intersects(ranges)
+
+    def contains(self, key: RoutingKey) -> bool:
+        if self.keys is not None:
+            return self.keys.contains(key)
+        return self.ranges.contains(key)
+
+    def __eq__(self, other):
+        return (isinstance(other, Route) and self.home_key == other.home_key
+                and self.keys == other.keys and self.ranges == other.ranges
+                and self.is_full == other.is_full)
+
+    def __hash__(self):
+        return hash((self.home_key, self.keys, self.ranges, self.is_full))
+
+    def __repr__(self):
+        body = self.keys if self.keys is not None else self.ranges
+        return f"Route(home={self.home_key}, {body!r}, full={self.is_full})"

@@ -1,0 +1,298 @@
+"""Hybrid-logical-clock timestamps and transaction identity.
+
+Reference: accord/primitives/Timestamp.java:27-140 (bit layout :36-44,80-90),
+TxnId.java:32,124-157, Ballot.java:23, Txn.java:53-265 (kind conflict matrix
+:220-260).
+
+Bit layout follows the reference's 128-bit packing so timestamps round-trip
+losslessly to a pair of int64 device lanes (accord_tpu.ops.timestamps):
+    msb = epoch(48b) | hlc_high(16b)
+    lsb = hlc_low(48b) | flags(16b)      flags: REJECTED=0x8000, domain(1b), kind(3b)
+plus a 32-bit node id used as the final comparison tie-breaker.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Tuple
+
+from accord_tpu.utils import invariants
+
+FLAG_REJECTED = 0x8000
+_KIND_SHIFT = 1          # bits 1..3 of flags
+_KIND_MASK = 0b111 << _KIND_SHIFT
+_DOMAIN_MASK = 0b1       # bit 0 of flags
+_HLC_LOW_BITS = 48
+_HLC_LOW_MASK = (1 << _HLC_LOW_BITS) - 1
+_EPOCH_BITS = 48
+MAX_EPOCH = (1 << _EPOCH_BITS) - 1
+
+
+class Domain(enum.IntEnum):
+    KEY = 0
+    RANGE = 1
+
+
+class TxnKind(enum.IntEnum):
+    """Transaction kinds (reference Txn.Kind, Txn.java:53).
+
+    The conflict matrix (witnesses/witnessedBy, Txn.java:220-260) decides which
+    prior transactions appear in a new transaction's dependency set.
+    """
+
+    READ = 1
+    WRITE = 2
+    EPHEMERAL_READ = 3
+    SYNC_POINT = 4
+    EXCLUSIVE_SYNC_POINT = 5
+    LOCAL_ONLY = 6
+
+    def witnesses(self) -> "KindSet":
+        return _WITNESSES[self]
+
+    def witnessed_by(self) -> "KindSet":
+        return _WITNESSED_BY[self]
+
+    @property
+    def is_write(self) -> bool:
+        return self is TxnKind.WRITE or self is TxnKind.EXCLUSIVE_SYNC_POINT
+
+    @property
+    def is_read(self) -> bool:
+        return self in (TxnKind.READ, TxnKind.EPHEMERAL_READ)
+
+    @property
+    def is_sync_point(self) -> bool:
+        return self in (TxnKind.SYNC_POINT, TxnKind.EXCLUSIVE_SYNC_POINT)
+
+    @property
+    def is_globally_visible(self) -> bool:
+        """Can this txn appear in other txns' deps? (Txn.java AnyGloballyVisible)"""
+        return self not in (TxnKind.EPHEMERAL_READ, TxnKind.LOCAL_ONLY)
+
+    @property
+    def awaits_only_deps(self) -> bool:
+        """Sync points execute once deps apply; they have no data read/write."""
+        return self.is_sync_point
+
+
+class KindSet(frozenset):
+    """A set of TxnKinds with a packed-int device encoding."""
+
+    def test(self, kind: TxnKind) -> bool:
+        return kind in self
+
+    def mask(self) -> int:
+        m = 0
+        for k in self:
+            m |= 1 << int(k)
+        return m
+
+
+WRITES = KindSet({TxnKind.WRITE, TxnKind.EXCLUSIVE_SYNC_POINT})
+READS_OR_WRITES = KindSet({TxnKind.READ, TxnKind.WRITE,
+                           TxnKind.EXCLUSIVE_SYNC_POINT})
+ANY_GLOBALLY_VISIBLE = KindSet({TxnKind.READ, TxnKind.WRITE, TxnKind.SYNC_POINT,
+                                TxnKind.EXCLUSIVE_SYNC_POINT})
+NONE_KINDS = KindSet()
+
+# what each kind's deps must include (Txn.java:220-260: Reads witness Ws;
+# Writes witness RsOrWs; ESP witnesses AnyGloballyVisible).
+_WITNESSES = {
+    TxnKind.READ: WRITES,
+    TxnKind.WRITE: READS_OR_WRITES,
+    TxnKind.EPHEMERAL_READ: WRITES,
+    TxnKind.SYNC_POINT: READS_OR_WRITES,
+    TxnKind.EXCLUSIVE_SYNC_POINT: ANY_GLOBALLY_VISIBLE,
+    TxnKind.LOCAL_ONLY: NONE_KINDS,
+}
+
+_WITNESSED_BY = {
+    k: KindSet({o for o in TxnKind if k in _WITNESSES[o]}) for k in TxnKind
+}
+
+
+class Timestamp:
+    """Immutable 128-bit HLC timestamp + node id.
+
+    Total order: (epoch, hlc, flags, node) lexicographic — identical to the
+    reference's msb/lsb/node compare (Timestamp.java compareTo).
+    """
+
+    __slots__ = ("epoch", "hlc", "flags", "node")
+
+    def __init__(self, epoch: int, hlc: int, flags: int, node: int):
+        invariants.check_argument(0 <= epoch <= MAX_EPOCH, "epoch out of range")
+        self.epoch = epoch
+        self.hlc = hlc
+        self.flags = flags
+        self.node = node
+
+    # -- construction --
+    @classmethod
+    def from_bits(cls, epoch: int, hlc: int, flags: int, node: int) -> "Timestamp":
+        return cls(epoch, hlc, flags, node)
+
+    @classmethod
+    def none(cls) -> "Timestamp":
+        return NONE
+
+    @classmethod
+    def max_value(cls) -> "Timestamp":
+        return MAX
+
+    def with_epoch_at_least(self, epoch: int) -> "Timestamp":
+        return self if epoch <= self.epoch else type(self)(epoch, self.hlc, self.flags, self.node)
+
+    def with_flags(self, flags: int) -> "Timestamp":
+        return type(self)(self.epoch, self.hlc, flags, self.node)
+
+    def as_rejected(self) -> "Timestamp":
+        return self.with_flags(self.flags | FLAG_REJECTED)
+
+    @property
+    def is_rejected(self) -> bool:
+        return bool(self.flags & FLAG_REJECTED)
+
+    def next_hlc(self) -> "Timestamp":
+        return Timestamp(self.epoch, self.hlc + 1, 0, self.node)
+
+    # -- packing (device lanes; reference bit layout Timestamp.java:36-44) --
+    def msb(self) -> int:
+        return (self.epoch << 16) | ((self.hlc >> _HLC_LOW_BITS) & 0xFFFF)
+
+    def lsb(self) -> int:
+        return ((self.hlc & _HLC_LOW_MASK) << 16) | (self.flags & 0xFFFF)
+
+    def pack(self) -> Tuple[int, int, int]:
+        return (self.msb(), self.lsb(), self.node)
+
+    @classmethod
+    def unpack(cls, msb: int, lsb: int, node: int) -> "Timestamp":
+        epoch = msb >> 16
+        hlc = ((msb & 0xFFFF) << _HLC_LOW_BITS) | (lsb >> 16)
+        return cls(epoch, hlc, lsb & 0xFFFF, node)
+
+    # -- ordering --
+    def _key(self):
+        return (self.epoch, self.hlc, self.flags, self.node)
+
+    def __lt__(self, other): return self._key() < other._key()
+    def __le__(self, other): return self._key() <= other._key()
+    def __gt__(self, other): return self._key() > other._key()
+    def __ge__(self, other): return self._key() >= other._key()
+
+    def __eq__(self, other):
+        return isinstance(other, Timestamp) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def compare_to(self, other: "Timestamp") -> int:
+        a, b = self._key(), other._key()
+        return -1 if a < b else (1 if a > b else 0)
+
+    @staticmethod
+    def max(a: "Timestamp", b: "Timestamp") -> "Timestamp":
+        return a if a >= b else b
+
+    @staticmethod
+    def min(a: "Timestamp", b: "Timestamp") -> "Timestamp":
+        return a if a <= b else b
+
+    @staticmethod
+    def non_null_or_max(a: Optional["Timestamp"], b: Optional["Timestamp"]):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return Timestamp.max(a, b)
+
+    def merge_max(self, other: "Timestamp") -> "Timestamp":
+        """Component-wise dominance merge used by HLC propagation."""
+        return self if self >= other else other
+
+    def __repr__(self):
+        return f"[{self.epoch},{self.hlc},{self.flags:x},{self.node}]"
+
+
+class TxnId(Timestamp):
+    """Timestamp whose flags carry Txn kind (3b) + domain (1b).
+
+    Reference: TxnId.java:32,124-157.
+    """
+
+    __slots__ = ()
+
+    def __init__(self, epoch: int, hlc: int, flags: int, node: int):
+        super().__init__(epoch, hlc, flags, node)
+
+    @classmethod
+    def create(cls, epoch: int, hlc: int, kind: TxnKind, domain: Domain,
+               node: int) -> "TxnId":
+        flags = (int(kind) << _KIND_SHIFT) | int(domain)
+        return cls(epoch, hlc, flags, node)
+
+    @classmethod
+    def from_timestamp(cls, ts: Timestamp) -> "TxnId":
+        return cls(ts.epoch, ts.hlc, ts.flags, ts.node)
+
+    @property
+    def kind(self) -> TxnKind:
+        return TxnKind((self.flags & _KIND_MASK) >> _KIND_SHIFT)
+
+    @property
+    def domain(self) -> Domain:
+        return Domain(self.flags & _DOMAIN_MASK)
+
+    @property
+    def is_key_domain(self) -> bool:
+        return self.domain is Domain.KEY
+
+    @property
+    def is_range_domain(self) -> bool:
+        return self.domain is Domain.RANGE
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind.is_write
+
+    @property
+    def is_visible(self) -> bool:
+        return self.kind.is_globally_visible
+
+    def witnesses(self, other: "TxnId") -> bool:
+        """Must `other` (an earlier txn) appear in this txn's deps?"""
+        return other.kind in self.kind.witnesses()
+
+    def witnessed_by(self, other_kind: TxnKind) -> bool:
+        return other_kind in self.kind.witnessed_by()
+
+    def as_timestamp(self) -> Timestamp:
+        return Timestamp(self.epoch, self.hlc, self.flags, self.node)
+
+    def __repr__(self):
+        return (f"{self.kind.name[0]}{'R' if self.is_range_domain else ''}"
+                f"[{self.epoch},{self.hlc},{self.node}]")
+
+
+class Ballot(Timestamp):
+    """Paxos-style promise ballot (reference Ballot.java:23)."""
+
+    __slots__ = ()
+
+    ZERO: "Ballot"
+
+    @classmethod
+    def zero(cls) -> "Ballot":
+        return BALLOT_ZERO
+
+    def __repr__(self):
+        return f"B[{self.epoch},{self.hlc},{self.node}]"
+
+
+NONE = Timestamp(0, 0, 0, 0)
+MAX = Timestamp(MAX_EPOCH, (1 << 63) - 1, 0xFFFF, (1 << 31) - 1)
+BALLOT_ZERO = Ballot(0, 0, 0, 0)
+Ballot.ZERO = BALLOT_ZERO
+TXNID_NONE = TxnId(0, 0, 0, 0)

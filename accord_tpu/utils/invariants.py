@@ -1,0 +1,64 @@
+"""Assertion layer (reference: accord/utils/Invariants.java:31-38).
+
+All protocol invariants funnel through here so paranoia level is centrally
+switchable: tests run PARANOID, benchmarks run NONE.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+
+
+class Paranoia(enum.IntEnum):
+    NONE = 0
+    EXPENSIVE = 1
+    PARANOID = 2
+
+
+_LEVEL = Paranoia[os.environ.get("ACCORD_PARANOIA", "EXPENSIVE").upper()]
+
+
+def paranoia() -> Paranoia:
+    return _LEVEL
+
+
+def set_paranoia(level: Paranoia) -> None:
+    global _LEVEL
+    _LEVEL = level
+
+
+class InvariantError(AssertionError):
+    pass
+
+
+def illegal_state(msg: str = "illegal state"):
+    raise InvariantError(msg)
+
+
+def check(condition, msg: str = "invariant violated", *args):
+    if not condition:
+        raise InvariantError(msg % args if args else msg)
+    return condition
+
+
+def check_state(condition, msg: str = "illegal state", *args):
+    if not condition:
+        raise InvariantError(msg % args if args else msg)
+
+
+def check_argument(condition, msg: str = "illegal argument", *args):
+    if not condition:
+        raise InvariantError(msg % args if args else msg)
+
+
+def non_null(value, msg: str = "unexpected None"):
+    if value is None:
+        raise InvariantError(msg)
+    return value
+
+
+def expensive_check(condition_fn, msg: str = "expensive invariant violated"):
+    """Run condition_fn only when paranoia >= EXPENSIVE."""
+    if _LEVEL >= Paranoia.EXPENSIVE and not condition_fn():
+        raise InvariantError(msg)

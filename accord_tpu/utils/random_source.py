@@ -1,0 +1,85 @@
+"""Forkable deterministic randomness SPI (reference: accord/utils/RandomSource.java).
+
+Every source of randomness in the protocol and the simulator flows through a
+RandomSource so whole-cluster runs are reproducible from one seed, and `fork()`
+yields independent deterministic streams (the property the burn test's
+reconcile mode asserts).
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+from typing import List, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+class RandomSource:
+    """Deterministic PRNG with forking. Backed by Python's Mersenne twister."""
+
+    def __init__(self, seed: int):
+        self._seed = seed
+        self._rng = _pyrandom.Random(seed)
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def fork(self) -> "RandomSource":
+        return RandomSource(self._rng.getrandbits(63))
+
+    def next_int(self, bound_or_min: int, bound: int = None) -> int:
+        """next_int(bound) -> [0, bound); next_int(lo, hi) -> [lo, hi)."""
+        if bound is None:
+            return self._rng.randrange(bound_or_min)
+        return self._rng.randrange(bound_or_min, bound)
+
+    def next_long(self) -> int:
+        return self._rng.getrandbits(63)
+
+    def next_float(self) -> float:
+        return self._rng.random()
+
+    def next_bool(self) -> bool:
+        return self._rng.getrandbits(1) == 1
+
+    def decide(self, probability: float) -> bool:
+        return self._rng.random() < probability
+
+    def pick(self, xs: Sequence[T]) -> T:
+        return xs[self._rng.randrange(len(xs))]
+
+    def pick_weighted(self, xs: Sequence[T], weights: Sequence[float]) -> T:
+        return self._rng.choices(list(xs), weights=list(weights), k=1)[0]
+
+    def sample(self, xs: Sequence[T], k: int) -> List[T]:
+        return self._rng.sample(list(xs), k)
+
+    def shuffle(self, xs: list) -> list:
+        self._rng.shuffle(xs)
+        return xs
+
+    def next_gauss(self, mu: float = 0.0, sigma: float = 1.0) -> float:
+        return self._rng.gauss(mu, sigma)
+
+    def next_zipf(self, n: int, alpha: float = 0.99) -> int:
+        """Zipfian index in [0, n) via inverse-CDF rejection (test workloads)."""
+        # Rejection-inversion (Jain's approximation) — adequate for workloads.
+        while True:
+            u = self._rng.random()
+            x = int(n ** u)
+            if x < n and self._rng.random() < (1.0 / (x + 1)) ** alpha / (1.0 / 1.0) ** alpha:
+                return x
+
+    def biased_uniform(self, lo: int, hi: int, median: int) -> int:
+        """Uniform with median skew (reference RandomSource.biasedUniformInts)."""
+        if self._rng.getrandbits(1):
+            return self._rng.randrange(lo, max(lo + 1, median))
+        return self._rng.randrange(min(median, hi - 1), hi)
+
+
+class DefaultRandom(RandomSource):
+    def __init__(self, seed: int = None):
+        if seed is None:
+            seed = _pyrandom.SystemRandom().getrandbits(63)
+        super().__init__(seed)

@@ -1,0 +1,128 @@
+"""Randomized CSR invariants for KeyDeps/RangeDeps/Deps (reference model:
+accord-core test KeyDepsTest:586LoC, RangeDepsTest)."""
+
+import random
+
+import pytest
+
+from accord_tpu.primitives.deps import Deps, KeyDeps, RangeDeps
+from accord_tpu.primitives.keys import Key, Keys, Range, Ranges, RoutingKey
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+
+def tid(hlc, node=1, kind=TxnKind.WRITE, epoch=1, domain=Domain.KEY):
+    return TxnId.create(epoch, hlc, kind, domain, node)
+
+
+def random_key_deps(rng, nkeys=8, ntxns=12, density=0.3):
+    model = {}
+    ids = [tid(h, node=rng.randrange(1, 4)) for h in rng.sample(range(100), ntxns)]
+    for k in rng.sample(range(50), nkeys):
+        chosen = {t for t in ids if rng.random() < density}
+        if chosen:
+            model[Key(k)] = chosen
+    return model, KeyDeps.of(model)
+
+
+class TestKeyDeps:
+    @pytest.mark.parametrize("seed", range(25))
+    def test_csr_matches_model(self, seed):
+        rng = random.Random(seed)
+        model, deps = random_key_deps(rng)
+        assert sorted(k.token for k in deps.keys) == sorted(k.token for k in model)
+        for k, ids in model.items():
+            assert deps.txn_ids_for_key(k) == sorted(ids)
+        # txn_ids is the sorted union
+        all_ids = sorted(set().union(*model.values())) if model else []
+        assert list(deps.txn_ids) == all_ids
+        for t in all_ids:
+            assert deps.contains(t)
+            expect_keys = sorted(k.token for k, ids in model.items() if t in ids)
+            assert deps.participants(t).tokens() == expect_keys
+
+    @pytest.mark.parametrize("seed", range(15))
+    def test_with_union(self, seed):
+        rng = random.Random(1000 + seed)
+        m1, d1 = random_key_deps(rng)
+        m2, d2 = random_key_deps(rng)
+        merged = d1.with_(d2)
+        model = {k: set(v) for k, v in m1.items()}
+        for k, v in m2.items():
+            model.setdefault(k, set()).update(v)
+        assert merged == KeyDeps.of(model)
+
+    @pytest.mark.parametrize("seed", range(15))
+    def test_merge_nway_equals_pairwise(self, seed):
+        rng = random.Random(2000 + seed)
+        parts = [random_key_deps(rng)[1] for _ in range(4)]
+        nway = KeyDeps.merge(parts)
+        pairwise = parts[0]
+        for p in parts[1:]:
+            pairwise = pairwise.with_(p)
+        assert nway == pairwise
+
+    def test_without_and_slice(self):
+        rng = random.Random(7)
+        model, deps = random_key_deps(rng)
+        cutoff = tid(50)
+        pruned = deps.without(lambda t: t < cutoff)
+        for k in pruned.keys:
+            assert all(t >= cutoff for t in pruned.txn_ids_for_key(k))
+        rs = Ranges.of((0, 25))
+        sliced = deps.slice(rs)
+        assert all(k.token < 25 for k in sliced.keys)
+        for k in sliced.keys:
+            assert sliced.txn_ids_for_key(k) == deps.txn_ids_for_key(k)
+
+    def test_empty(self):
+        assert KeyDeps.NONE.is_empty
+        assert KeyDeps.builder().build() is KeyDeps.NONE
+        assert KeyDeps.NONE.with_(KeyDeps.NONE).is_empty
+
+
+class TestRangeDeps:
+    def test_stabbing_queries(self):
+        a, b, c = tid(1, domain=Domain.RANGE), tid(2, domain=Domain.RANGE), tid(3, domain=Domain.RANGE)
+        deps = RangeDeps.of({
+            Range(0, 10): {a}, Range(5, 15): {b}, Range(20, 30): {c},
+        })
+        found = []
+        deps.for_each_covering(RoutingKey(7), found.append)
+        assert sorted(found) == sorted([a, b])
+        found2 = []
+        deps.for_each_intersecting(Range(12, 25), found2.append)
+        assert sorted(found2) == sorted([b, c])
+        assert deps.participants(b) == Ranges.of((5, 15))
+
+    def test_overlapping_ranges_kept_distinct(self):
+        a, b = tid(1, domain=Domain.RANGE), tid(2, domain=Domain.RANGE)
+        deps = RangeDeps.of({Range(0, 10): {a}, Range(0, 10): {a, b}})
+        assert deps.txn_id_count() == 2
+
+    def test_slice_intersects(self):
+        a = tid(1, domain=Domain.RANGE)
+        deps = RangeDeps.of({Range(0, 100): {a}})
+        s = deps.slice(Ranges.of((40, 60)))
+        assert list(s.ranges) == [Range(40, 60)]
+        assert s.contains(a)
+
+
+class TestDeps:
+    def test_pair_merge(self):
+        k1 = tid(1)
+        r1 = tid(2, domain=Domain.RANGE)
+        d1 = Deps(KeyDeps.of({Key(5): {k1}}), RangeDeps.NONE)
+        d2 = Deps(KeyDeps.NONE, RangeDeps.of({Range(0, 10): {r1}}))
+        m = Deps.merge([d1, d2])
+        assert m.contains(k1) and m.contains(r1)
+        assert m.txn_id_count() == 2
+        assert m.sorted_txn_ids() == sorted([k1, r1])
+        assert m.max_txn_id() == max(k1, r1)
+
+    def test_slice_and_without(self):
+        k1, k2 = tid(1), tid(2)
+        d = Deps(KeyDeps.of({Key(5): {k1}, Key(50): {k2}}), RangeDeps.NONE)
+        s = d.slice(Ranges.of((0, 10)))
+        assert s.contains(k1) and not s.contains(k2)
+        w = d.without(lambda t: t == k1)
+        assert not w.contains(k1) and w.contains(k2)

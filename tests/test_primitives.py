@@ -1,0 +1,185 @@
+"""Tests for Timestamp/TxnId bit layout, kinds matrix, Routables, interval maps,
+bitsets (reference models: TimestampTest-equivalents, KeysTest, RangeTest,
+ReducingRangeMapTest, SimpleBitSetTest)."""
+
+import random
+
+import pytest
+
+from accord_tpu.primitives.keys import (
+    Key, Keys, Range, Ranges, Route, RoutingKey, RoutingKeys,
+)
+from accord_tpu.primitives.timestamp import (
+    Ballot, Domain, Timestamp, TxnId, TxnKind, FLAG_REJECTED,
+)
+from accord_tpu.utils.bitset import ImmutableBitSet, SimpleBitSet
+from accord_tpu.utils.interval_map import ReducingRangeMap
+
+
+class TestTimestamp:
+    def test_pack_unpack_roundtrip(self):
+        rng = random.Random(0)
+        for _ in range(200):
+            ts = Timestamp(rng.randrange(1 << 48), rng.randrange(1 << 63),
+                           rng.randrange(1 << 16), rng.randrange(1 << 31))
+            assert Timestamp.unpack(*ts.pack()) == ts
+
+    def test_ordering_is_epoch_hlc_flags_node(self):
+        a = Timestamp(1, 5, 0, 1)
+        assert a < Timestamp(2, 0, 0, 0)
+        assert a < Timestamp(1, 6, 0, 0)
+        assert a < Timestamp(1, 5, 1, 0)
+        assert a < Timestamp(1, 5, 0, 2)
+        assert Timestamp.max(a, Timestamp(1, 5, 0, 2)) == Timestamp(1, 5, 0, 2)
+
+    def test_msb_lsb_order_matches_logical_order(self):
+        # device comparisons use (msb, lsb, node) lexicographic; must agree
+        rng = random.Random(1)
+        pts = [Timestamp(rng.randrange(1 << 20), rng.randrange(1 << 40),
+                         rng.randrange(1 << 16), rng.randrange(1 << 16))
+               for _ in range(100)]
+        logical = sorted(pts)
+        packed = sorted(pts, key=lambda t: t.pack())
+        assert logical == packed
+
+    def test_rejected_flag(self):
+        ts = Timestamp(3, 7, 0, 2)
+        assert not ts.is_rejected
+        assert ts.as_rejected().is_rejected
+        assert ts.as_rejected() > ts  # rejected sorts after (flag bit is high)
+
+    def test_epoch_at_least(self):
+        ts = Timestamp(3, 7, 0, 2)
+        assert ts.with_epoch_at_least(2) is ts
+        assert ts.with_epoch_at_least(5).epoch == 5
+
+
+class TestTxnId:
+    def test_kind_domain_roundtrip(self):
+        for kind in TxnKind:
+            for dom in Domain:
+                t = TxnId.create(4, 99, kind, dom, 7)
+                assert t.kind == kind
+                assert t.domain == dom
+                # survives pack/unpack
+                t2 = TxnId.unpack(*t.pack())
+                assert TxnId.from_timestamp(t2).kind == kind
+
+    def test_witness_matrix(self):
+        r = TxnKind.READ
+        w = TxnKind.WRITE
+        sp = TxnKind.SYNC_POINT
+        esp = TxnKind.EXCLUSIVE_SYNC_POINT
+        assert w in r.witnesses() and r not in r.witnesses()
+        assert r in w.witnesses() and w in w.witnesses()
+        assert r in sp.witnesses() and w in sp.witnesses()
+        assert sp in esp.witnesses() and esp in esp.witnesses()
+        assert not TxnKind.LOCAL_ONLY.witnesses()
+        assert not TxnKind.EPHEMERAL_READ.is_globally_visible
+        # witnessed_by inverts witnesses
+        for a in TxnKind:
+            for b in TxnKind:
+                assert (a in b.witnesses()) == (b in a.witnessed_by())
+
+    def test_ballot_zero(self):
+        assert Ballot.zero() == Ballot(0, 0, 0, 0)
+        assert Ballot.zero() < Ballot(0, 1, 0, 0)
+
+
+class TestKeysRanges:
+    def test_keys_sorted_unique(self):
+        ks = Keys.of(5, 1, 3, 1)
+        assert ks.tokens() == [1, 3, 5]
+        assert ks.contains(Key(3)) and not ks.contains(Key(2))
+        assert ks.find(Key(3)) == 1
+        assert ks.find(Key(2)) == -2
+
+    def test_keys_algebra(self):
+        a, b = Keys.of(1, 3, 5), Keys.of(3, 4)
+        assert a.with_(b).tokens() == [1, 3, 4, 5]
+        assert a.intersecting(b).tokens() == [3]
+        assert a.subtract(b).tokens() == [1, 5]
+
+    def test_keys_slice(self):
+        ks = Keys.of(1, 3, 5, 7, 9)
+        assert ks.slice(Ranges.of((3, 8))).tokens() == [3, 5, 7]
+        assert ks.intersects_ranges(Ranges.of((8, 10)))
+        assert not ks.intersects_ranges(Ranges.of((10, 20)))
+
+    def test_ranges_normalize(self):
+        rs = Ranges([Range(5, 8), Range(1, 3), Range(2, 6)])
+        assert list(rs) == [Range(1, 8)]
+
+    def test_ranges_algebra(self):
+        a = Ranges.of((0, 10), (20, 30))
+        b = Ranges.of((5, 25))
+        assert list(a.intersection(b)) == [Range(5, 10), Range(20, 25)]
+        assert a.intersects(b)
+        assert list(a.subtract(b)) == [Range(0, 5), Range(25, 30)]
+        assert a.contains(RoutingKey(9)) and not a.contains(RoutingKey(15))
+        assert a.contains_all_ranges(Ranges.of((21, 29)))
+        assert not a.contains_all_ranges(Ranges.of((9, 11)))
+
+    def test_route(self):
+        route = Route.of_keys(RoutingKey(3), RoutingKeys.of(3, 7, 11))
+        assert route.is_key_domain and route.is_full
+        sliced = route.slice(Ranges.of((0, 8)))
+        assert sliced.keys.tokens() == [3, 7]
+        assert not sliced.is_full
+        assert route.covering().contains(RoutingKey(7))
+
+
+class TestBitSet:
+    def test_basic_ops(self):
+        bs = SimpleBitSet(10)
+        assert bs.set(3) and not bs.set(3)
+        bs.set(7)
+        assert bs.get(3) and bs.get(7) and not bs.get(4)
+        assert bs.count() == 2
+        assert list(bs) == [3, 7]
+        assert bs.first_set() == 3
+        assert bs.next_set(4) == 7
+        assert bs.prev_set(6) == 3
+        assert bs.unset(3) and not bs.unset(3)
+        assert bs.first_set() == 7
+
+    def test_immutable(self):
+        ib = ImmutableBitSet(5, 0b101)
+        with pytest.raises(TypeError):
+            ib.set(1)
+        m = ib.mutable()
+        m.set(1)
+        assert list(m) == [0, 1, 2]
+        assert list(ib) == [0, 2]
+
+
+class TestReducingRangeMap:
+    def test_update_and_get(self):
+        m = ReducingRangeMap()
+        m = m.update(0, 10, 5, max)
+        m = m.update(5, 15, 7, max)
+        assert m.get(-1) is None
+        assert m.get(0) == 5
+        assert m.get(5) == 7
+        assert m.get(12) == 7
+        assert m.get(15) is None
+
+    def test_update_reduces_with_existing(self):
+        m = ReducingRangeMap().update(0, 10, 5, max).update(2, 4, 3, max)
+        assert m.get(3) == 5  # max(5,3)
+        m2 = m.update(2, 4, 9, max)
+        assert m2.get(3) == 9
+        assert m2.get(5) == 5
+
+    def test_merge_pointwise(self):
+        a = ReducingRangeMap().update(0, 10, 5, max)
+        b = ReducingRangeMap().update(5, 20, 7, max)
+        m = a.merge(b, max)
+        assert m.get(2) == 5 and m.get(7) == 7 and m.get(15) == 7
+        assert m.get(25) is None
+
+    def test_fold_max(self):
+        m = ReducingRangeMap().update(0, 10, 5, max).update(10, 20, 9, max)
+        assert m.fold_max(0, 30) == 9
+        assert m.fold_max(0, 10) == 5
+        assert m.fold_max(30, 40) is None

@@ -1,0 +1,85 @@
+"""Property tests for the sorted-array kernels (reference model:
+accord-core test SortedArraysTest)."""
+
+import random
+
+import pytest
+
+from accord_tpu.utils.sorted_arrays import (
+    binary_search, exponential_search, find_ceil, find_floor, find_next,
+    fold_intersection, is_sorted_unique, linear_intersection, linear_subtract,
+    linear_union, merge_sorted_unique, next_intersection,
+)
+
+
+def random_sorted(rng, n, universe=200):
+    return sorted(rng.sample(range(universe), min(n, universe)))
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_union_intersection_subtract_vs_sets(seed):
+    rng = random.Random(seed)
+    a = random_sorted(rng, rng.randrange(0, 50))
+    b = random_sorted(rng, rng.randrange(0, 50))
+    assert linear_union(a, b) == sorted(set(a) | set(b))
+    assert linear_intersection(a, b) == sorted(set(a) & set(b))
+    assert linear_subtract(a, b) == sorted(set(a) - set(b))
+    assert is_sorted_unique(linear_union(a, b))
+
+
+def test_union_identity_fastpaths():
+    a = [1, 2, 3]
+    assert linear_union(a, []) is a
+    assert linear_union([], a) is a
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_binary_and_exponential_search(seed):
+    rng = random.Random(100 + seed)
+    xs = random_sorted(rng, 40)
+    for target in range(-1, 210, 7):
+        bi = binary_search(xs, target)
+        ei = exponential_search(xs, target)
+        if target in xs:
+            assert xs[bi] == target
+            assert xs[ei] == target
+        else:
+            assert bi < 0 and ei < 0
+            ins = -1 - bi
+            assert all(x < target for x in xs[:ins])
+            assert all(x > target for x in xs[ins:])
+            assert -1 - ei == ins
+
+
+def test_ceil_floor():
+    xs = [10, 20, 30]
+    assert find_ceil(xs, 5) == 0
+    assert find_ceil(xs, 10) == 0
+    assert find_ceil(xs, 11) == 1
+    assert find_ceil(xs, 31) == 3
+    assert find_floor(xs, 5) == -1
+    assert find_floor(xs, 10) == 0
+    assert find_floor(xs, 25) == 1
+    assert find_floor(xs, 35) == 2
+    assert find_next(xs, 0, 15) == 1
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_next_intersection_walks_all_common(seed):
+    rng = random.Random(200 + seed)
+    a = random_sorted(rng, 30)
+    b = random_sorted(rng, 30)
+    common = []
+    pos = next_intersection(a, 0, b, 0)
+    while pos is not None:
+        ai, bi = pos
+        assert a[ai] == b[bi]
+        common.append(a[ai])
+        pos = next_intersection(a, ai + 1, b, bi + 1)
+    assert common == sorted(set(a) & set(b))
+    assert fold_intersection(a, b, lambda acc, x: acc + [x], []) == common
+
+
+def test_merge_sorted_unique_nway():
+    arrays = [[1, 5, 9], [2, 5, 7], [], [9, 11]]
+    assert merge_sorted_unique(arrays) == [1, 2, 5, 7, 9, 11]
