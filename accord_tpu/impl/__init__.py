@@ -1,0 +1,1 @@
+"""Reference SPI implementations (reference: accord/impl — SURVEY.md §2.7)."""

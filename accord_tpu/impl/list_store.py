@@ -1,0 +1,150 @@
+"""Append-only list-register data plane — the reference workload implementation.
+
+Reference: the maelstrom data plane (accord-maelstrom Maelstrom{Read,Write,
+Update,Query,Result,Data}, Datum.java:30, MaelstromUpdate.java:40-47): a
+multi-key KV where each key holds an append-only list of ints; reads return
+the list, updates append. This is the workload the burn test's strict
+serializability verifier checks (monotonic per-key append sequences).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from accord_tpu.api.data import Data, Query, Read, Result, Update, Write
+from accord_tpu.api.spi import DataStore
+from accord_tpu.primitives.keys import Key, Keys, Ranges
+from accord_tpu.primitives.timestamp import Timestamp, TxnId
+from accord_tpu.utils.async_chains import AsyncResult, success
+
+
+class ListStore(DataStore):
+    """key -> (list of appended values, last write timestamp)."""
+
+    def __init__(self, node_id: int = 0):
+        self.node_id = node_id
+        self.data: Dict[Key, List[int]] = {}
+        self.write_ts: Dict[Key, Timestamp] = {}
+
+    def get(self, key: Key) -> Tuple[int, ...]:
+        return tuple(self.data.get(key, ()))
+
+    def append(self, key: Key, value: int, at: Timestamp) -> None:
+        prev = self.write_ts.get(key)
+        # idempotent replay guard: applies are ordered per key by executeAt
+        if prev is not None and at <= prev:
+            return
+        self.data.setdefault(key, []).append(value)
+        self.write_ts[key] = at
+
+    def snapshot(self) -> Dict[int, Tuple[int, ...]]:
+        return {k.token: tuple(v) for k, v in self.data.items()}
+
+
+class ListData(Data):
+    def __init__(self, values: Dict[Key, Tuple[int, ...]]):
+        self.values = dict(values)
+
+    def merge(self, other: "Data") -> "Data":
+        merged = dict(self.values)
+        merged.update(other.values)  # type: ignore[attr-defined]
+        return ListData(merged)
+
+    def __eq__(self, other):
+        return isinstance(other, ListData) and self.values == other.values
+
+    def __repr__(self):
+        return f"ListData({ {k.token: v for k, v in self.values.items()} })"
+
+
+class ListRead(Read):
+    def __init__(self, keys: Keys):
+        self._keys = keys
+
+    def keys(self) -> Keys:
+        return self._keys
+
+    def read(self, key: Key, execute_at: Timestamp, store: ListStore
+             ) -> AsyncResult[Data]:
+        return success(ListData({key: store.get(key)}))
+
+    def slice(self, ranges: Ranges) -> "ListRead":
+        return ListRead(self._keys.slice(ranges))
+
+    def merge(self, other: "ListRead") -> "ListRead":
+        return ListRead(self._keys.with_(other._keys))
+
+    def __eq__(self, other):
+        return isinstance(other, ListRead) and self._keys == other._keys
+
+    def __repr__(self):
+        return f"ListRead({self._keys!r})"
+
+
+class ListWrite(Write):
+    def __init__(self, appends: Dict[Key, int]):
+        self.appends = dict(appends)
+
+    def apply(self, key: Key, execute_at: Timestamp, store: ListStore
+              ) -> AsyncResult[None]:
+        if key in self.appends:
+            store.append(key, self.appends[key], execute_at)
+        return success(None)
+
+    def __repr__(self):
+        return f"ListWrite({ {k.token: v for k, v in self.appends.items()} })"
+
+
+class ListUpdate(Update):
+    def __init__(self, appends: Dict[Key, int]):
+        self.appends = dict(appends)
+
+    def keys(self) -> Keys:
+        return Keys(self.appends.keys())
+
+    def apply(self, execute_at: Timestamp, data: Optional[Data]) -> Write:
+        return ListWrite(self.appends)
+
+    def slice(self, ranges: Ranges) -> "ListUpdate":
+        return ListUpdate({k: v for k, v in self.appends.items()
+                           if ranges.contains(k)})
+
+    def merge(self, other: "ListUpdate") -> "ListUpdate":
+        merged = dict(self.appends)
+        merged.update(other.appends)
+        return ListUpdate(merged)
+
+    def __eq__(self, other):
+        return isinstance(other, ListUpdate) and self.appends == other.appends
+
+    def __repr__(self):
+        return f"ListUpdate({ {k.token: v for k, v in self.appends.items()} })"
+
+
+class ListResult(Result):
+    def __init__(self, txn_id: TxnId, execute_at: Timestamp,
+                 read_values: Dict[Key, Tuple[int, ...]],
+                 appends: Dict[Key, int]):
+        self.txn_id = txn_id
+        self.execute_at = execute_at
+        self.read_values = dict(read_values)
+        self.appends = dict(appends)
+
+    def __eq__(self, other):
+        return (isinstance(other, ListResult) and self.txn_id == other.txn_id
+                and self.read_values == other.read_values
+                and self.appends == other.appends)
+
+    def __repr__(self):
+        return (f"ListResult({self.txn_id!r}: "
+                f"read={ {k.token: v for k, v in self.read_values.items()} }, "
+                f"appended={ {k.token: v for k, v in self.appends.items()} })")
+
+
+class ListQuery(Query):
+    def compute(self, txn_id: TxnId, execute_at: Timestamp,
+                data: Optional[Data], read: Optional[Read],
+                update: Optional[Update]) -> Result:
+        values = data.values if isinstance(data, ListData) else {}
+        appends = update.appends if isinstance(update, ListUpdate) else {}
+        return ListResult(txn_id, execute_at, values, appends)

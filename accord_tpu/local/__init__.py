@@ -1,0 +1,7 @@
+"""Local replica state machine (reference: accord/local — SURVEY.md §2.3)."""
+
+from accord_tpu.local.status import (
+    SaveStatus, Phase, Durability, Known, KnownRoute, KnownDefinition,
+    KnownExecuteAt, KnownDeps, KnownOutcome,
+)
+from accord_tpu.local.command import Command, WaitingOn

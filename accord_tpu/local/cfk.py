@@ -1,0 +1,322 @@
+"""CommandsForKey: the per-key conflict index — north-star kernel #1.
+
+Reference: accord/local/CommandsForKey.java:132 (TxnInfo :194-293, the
+mapReduceActive deps scan :614-650, mapReduceFull recovery queries :553-612,
+incremental update :652, Unmanaged registrations :140-184,1270) and
+accord/impl/TimestampsForKey.java:33.
+
+Host-side scalar implementation; the batched device equivalent (one XLA call
+computing deps for a whole window of transactions) lives in
+accord_tpu.ops.deps_kernel and must stay bit-identical to this path.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from accord_tpu.primitives.keys import Key
+from accord_tpu.primitives.timestamp import Timestamp, TxnId, TxnKind, KindSet
+from accord_tpu.utils import invariants
+from accord_tpu.utils.sorted_arrays import find_ceil
+
+
+class InternalStatus(enum.IntEnum):
+    """Compressed per-key view of a command's state
+    (CommandsForKey.InternalStatus, CommandsForKey.java:194)."""
+
+    TRANSITIVELY_KNOWN = 0   # known only via deps; never witnessed directly
+    HISTORICAL = 1
+    PREACCEPTED = 2
+    ACCEPTED = 3
+    COMMITTED = 4
+    STABLE = 5
+    APPLIED = 6
+    INVALID_OR_TRUNCATED = 7
+
+    @property
+    def is_committed(self) -> bool:
+        return InternalStatus.COMMITTED <= self <= InternalStatus.APPLIED
+
+    @property
+    def is_decided(self) -> bool:
+        return self >= InternalStatus.COMMITTED
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (InternalStatus.APPLIED, InternalStatus.INVALID_OR_TRUNCATED)
+
+
+class TxnInfo:
+    __slots__ = ("txn_id", "status", "execute_at", "ballot_accepted")
+
+    def __init__(self, txn_id: TxnId, status: InternalStatus,
+                 execute_at: Optional[Timestamp] = None):
+        self.txn_id = txn_id
+        self.status = status
+        self.execute_at = execute_at
+
+    def execute_at_or_txn_id(self) -> Timestamp:
+        return self.execute_at if self.execute_at is not None else self.txn_id
+
+    def __repr__(self):
+        return f"TxnInfo({self.txn_id!r}, {self.status.name}, at={self.execute_at!r})"
+
+
+class Unmanaged:
+    """A pending notification for a range/sync-point txn waiting on this key
+    (CommandsForKey.Unmanaged, :140-184): fire when every cross-key dep at this
+    key with executeAt <= `waiting_until` reaches COMMIT or APPLY."""
+
+    __slots__ = ("txn_id", "pending", "waiting_until", "callback")
+
+    COMMIT = "COMMIT"
+    APPLY = "APPLY"
+
+    def __init__(self, txn_id: TxnId, pending: str, waiting_until: Timestamp,
+                 callback: Callable[[], None]):
+        self.txn_id = txn_id
+        self.pending = pending
+        self.waiting_until = waiting_until
+        self.callback = callback
+
+
+class CommandsForKey:
+    """All transactions witnessed at one key, ordered by TxnId, with a
+    committed-by-executeAt view for execution ordering."""
+
+    __slots__ = ("key", "_by_id", "_ids", "_unmanaged", "redundant_before")
+
+    def __init__(self, key: Key):
+        self.key = key
+        self._by_id: Dict[TxnId, TxnInfo] = {}
+        self._ids: List[TxnId] = []          # sorted
+        self._unmanaged: List[Unmanaged] = []
+        self.redundant_before: Optional[TxnId] = None
+
+    # -- maintenance --
+    def update(self, txn_id: TxnId, status: InternalStatus,
+               execute_at: Optional[Timestamp] = None) -> None:
+        """Incremental maintenance on a command transition
+        (CommandsForKey.update, :652)."""
+        info = self._by_id.get(txn_id)
+        if info is None:
+            info = TxnInfo(txn_id, status, execute_at)
+            self._by_id[txn_id] = info
+            i = find_ceil(self._ids, txn_id)
+            self._ids.insert(i, txn_id)
+        else:
+            # per-key status only advances (monotone view of the command)
+            if status < info.status and not (
+                    status == InternalStatus.INVALID_OR_TRUNCATED):
+                return
+            info.status = status
+            if execute_at is not None:
+                info.execute_at = execute_at
+        if status.is_committed or status == InternalStatus.INVALID_OR_TRUNCATED:
+            self._notify_unmanaged()
+
+    def register_historical(self, txn_id: TxnId) -> None:
+        """Witness a txn known only transitively (registerHistorical)."""
+        if txn_id not in self._by_id:
+            self.update(txn_id, InternalStatus.TRANSITIVELY_KNOWN)
+
+    def prune_redundant(self, before: TxnId) -> None:
+        """Drop applied/invalidated txns below the redundancy watermark."""
+        self.redundant_before = (before if self.redundant_before is None
+                                 else max(self.redundant_before, before))
+        keep = [t for t in self._ids
+                if not (t < before and self._by_id[t].status.is_terminal)]
+        for t in set(self._ids) - set(keep):
+            del self._by_id[t]
+        self._ids = keep
+
+    # -- introspection --
+    def get(self, txn_id: TxnId) -> Optional[TxnInfo]:
+        return self._by_id.get(txn_id)
+
+    def size(self) -> int:
+        return len(self._ids)
+
+    def all_ids(self) -> List[TxnId]:
+        return list(self._ids)
+
+    def min_uncommitted(self) -> Optional[TxnId]:
+        for t in self._ids:
+            if not self._by_id[t].status.is_decided:
+                return t
+        return None
+
+    def max_committed_write_at(self) -> Optional[Timestamp]:
+        best: Optional[Timestamp] = None
+        for t in self._ids:
+            info = self._by_id[t]
+            if info.status.is_committed and t.kind.is_write:
+                at = info.execute_at_or_txn_id()
+                best = at if best is None or at > best else best
+        return best
+
+    def max_applied_write_at(self) -> Optional[Timestamp]:
+        best: Optional[Timestamp] = None
+        for t in self._ids:
+            info = self._by_id[t]
+            if info.status == InternalStatus.APPLIED and t.kind.is_write:
+                at = info.execute_at_or_txn_id()
+                best = at if best is None or at > best else best
+        return best
+
+    def max_conflict(self) -> Optional[Timestamp]:
+        """Max (txnId | committed executeAt) at this key — executeAt proposal
+        input."""
+        best: Optional[Timestamp] = None
+        for t in self._ids:
+            at = self._by_id[t].execute_at_or_txn_id()
+            best = at if best is None or at > best else best
+        return best
+
+    # -- the deps scan (mapReduceActive, CommandsForKey.java:614-650) --
+    def map_reduce_active(self, before: Timestamp, kinds: KindSet,
+                          fn: Callable[[TxnId], None]) -> None:
+        """Visit every active txn with txnId < `before` whose kind is in
+        `kinds` — the dependency calculation for a new txn at this key.
+
+        'Active' excludes invalidated/truncated txns and those pruned as
+        redundant; everything else (uncommitted or committed or applied) is a
+        dependency. (The reference additionally prunes txns transitively
+        covered by the max committed write — a strict optimization we apply in
+        the batched device path with an equivalence oracle.)
+        """
+        hi = find_ceil(self._ids, before)
+        for i in range(hi):
+            t = self._ids[i]
+            info = self._by_id[t]
+            if info.status == InternalStatus.INVALID_OR_TRUNCATED:
+                continue
+            if t.kind not in kinds:
+                continue
+            fn(t)
+
+    # -- recovery queries (mapReduceFull, CommandsForKey.java:553-612) --
+    def committed_executes_after_without_witnessing(
+            self, txn_id: TxnId, witnessed_by: Callable[[TxnId], bool]) -> bool:
+        """Any STABLE-or-later txn executing after txn_id whose deps omit it?
+        (rejectsFastPath input: hasStableExecutesAfterWithoutWitnessing)"""
+        for t in self._ids:
+            info = self._by_id[t]
+            if (InternalStatus.STABLE <= info.status <= InternalStatus.APPLIED
+                    and info.execute_at_or_txn_id() > txn_id
+                    and t.witnesses(txn_id) and not witnessed_by(t)):
+                return True
+        return False
+
+    def accepted_or_committed_started_after_without_witnessing(
+            self, txn_id: TxnId, witnessed_by: Callable[[TxnId], bool]) -> bool:
+        """Any ACCEPTED+ txn with txnId > txn_id whose deps omit it?
+        (rejectsFastPath input)"""
+        lo = find_ceil(self._ids, txn_id)
+        for i in range(lo, len(self._ids)):
+            t = self._ids[i]
+            if t == txn_id:
+                continue
+            info = self._by_id[t]
+            if InternalStatus.ACCEPTED <= info.status <= InternalStatus.APPLIED \
+                    and t.witnesses(txn_id) and not witnessed_by(t):
+                return True
+        return False
+
+    def stable_started_before_and_witnessed(
+            self, txn_id: TxnId, witnessed_by: Callable[[TxnId], bool]
+    ) -> List[TxnId]:
+        """STABLE+ txns with txnId < txn_id that DID witness it
+        (earlierCommittedWitness: evidence the fast path was taken)."""
+        hi = find_ceil(self._ids, txn_id)
+        out = []
+        for i in range(hi):
+            t = self._ids[i]
+            info = self._by_id[t]
+            if info.status >= InternalStatus.STABLE \
+                    and info.status != InternalStatus.INVALID_OR_TRUNCATED \
+                    and witnessed_by(t):
+                out.append(t)
+        return out
+
+    def accepted_or_committed_started_before_without_witnessing(
+            self, txn_id: TxnId, witnessed_by: Callable[[TxnId], bool]
+    ) -> List[TxnId]:
+        """ACCEPTED+ txns with txnId < txn_id whose deps omit txn_id
+        (earlierAcceptedNoWitness: must await their commit before deciding)."""
+        hi = find_ceil(self._ids, txn_id)
+        out = []
+        for i in range(hi):
+            t = self._ids[i]
+            info = self._by_id[t]
+            if InternalStatus.ACCEPTED <= info.status <= InternalStatus.APPLIED \
+                    and txn_id.witnesses(t) and not witnessed_by(t):
+                out.append(t)
+        return out
+
+    # -- unmanaged (cross-key) waits --
+    def register_unmanaged(self, unmanaged: Unmanaged) -> None:
+        self._unmanaged.append(unmanaged)
+        self._notify_unmanaged()
+
+    def _notify_unmanaged(self) -> None:
+        if not self._unmanaged:
+            return
+        fire: List[Unmanaged] = []
+        keep: List[Unmanaged] = []
+        for u in self._unmanaged:
+            if self._unmanaged_satisfied(u):
+                fire.append(u)
+            else:
+                keep.append(u)
+        self._unmanaged = keep
+        for u in fire:
+            u.callback()
+
+    def _unmanaged_satisfied(self, u: Unmanaged) -> bool:
+        for t in self._ids:
+            if t >= u.waiting_until or t == u.txn_id:
+                continue
+            info = self._by_id[t]
+            if not t.is_visible:
+                continue
+            if u.pending == Unmanaged.COMMIT:
+                if not info.status.is_decided:
+                    return False
+            else:  # APPLY
+                if not info.status.is_terminal:
+                    if not (info.status.is_committed
+                            and info.execute_at_or_txn_id() > u.waiting_until):
+                        return False
+        return True
+
+    def __repr__(self):
+        return f"CFK({self.key!r}, {len(self._ids)} txns)"
+
+
+class TimestampsForKey:
+    """Per-key execution timestamps (reference impl/TimestampsForKey.java:33):
+    lastExecutedTimestamp / lastWriteTimestamp feed executeAt validation and
+    the read-timestamp watermark."""
+
+    __slots__ = ("key", "last_executed", "last_write", "raw_hlc")
+
+    def __init__(self, key: Key):
+        self.key = key
+        self.last_executed: Optional[Timestamp] = None
+        self.last_write: Optional[Timestamp] = None
+        self.raw_hlc = 0
+
+    def on_executed(self, at: Timestamp, is_write: bool) -> None:
+        if self.last_executed is None or at > self.last_executed:
+            self.last_executed = at
+        if is_write and (self.last_write is None or at > self.last_write):
+            self.last_write = at
+        self.raw_hlc = max(self.raw_hlc, at.hlc)
+
+    def validate_execute_at(self, at: Timestamp) -> None:
+        invariants.check_state(
+            self.last_write is None or at >= self.last_write,
+            "executeAt %s precedes last write %s at %s", at, self.last_write,
+            self.key)

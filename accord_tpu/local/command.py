@@ -1,0 +1,202 @@
+"""Command: one transaction's replica-local record, and WaitingOn — the
+execution-order resolver state.
+
+Reference: accord/local/Command.java (record hierarchy :681-1216, WaitingOn
+:1294-1643, listeners :72-90). The reference uses immutable records swapped
+via SafeCommand; our stores are single-threaded (enforced by CommandStore), so
+Command is a mutable record whose every transition flows through the static
+functions in accord_tpu.local.commands — the moral equivalent of the
+reference's update() chain, with the same transition invariants.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from accord_tpu.local.status import Durability, Known, SaveStatus
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.txn import PartialTxn
+from accord_tpu.primitives.writes import Writes
+from accord_tpu.utils import invariants
+from accord_tpu.utils.bitset import SimpleBitSet
+from accord_tpu.utils.sorted_arrays import find_ceil
+
+
+class WaitingOn:
+    """Bitset over the stable deps this command must see applied before it can
+    execute (Command.java:1294-1643).
+
+    A dep blocks until it is (a) committed with executeAt AFTER ours — then it
+    is ordered after us and removed; or (b) applied / invalidated / truncated.
+    """
+
+    __slots__ = ("txn_ids", "waiting", "applied_or_invalidated")
+
+    def __init__(self, txn_ids: Tuple[TxnId, ...]):
+        self.txn_ids = txn_ids
+        self.waiting = SimpleBitSet.full(len(txn_ids)) if txn_ids else SimpleBitSet(0)
+        self.applied_or_invalidated = SimpleBitSet(len(txn_ids))
+
+    @classmethod
+    def from_deps(cls, deps: Deps) -> "WaitingOn":
+        return cls(tuple(deps.sorted_txn_ids()))
+
+    @property
+    def is_waiting(self) -> bool:
+        return not self.waiting.is_empty()
+
+    def index_of(self, txn_id: TxnId) -> int:
+        i = find_ceil(self.txn_ids, txn_id)
+        if i < len(self.txn_ids) and self.txn_ids[i] == txn_id:
+            return i
+        return -1
+
+    def is_waiting_on(self, txn_id: TxnId) -> bool:
+        i = self.index_of(txn_id)
+        return i >= 0 and self.waiting.get(i)
+
+    def remove_waiting_on(self, txn_id: TxnId) -> bool:
+        i = self.index_of(txn_id)
+        return i >= 0 and self.waiting.unset(i)
+
+    def set_applied_or_invalidated(self, txn_id: TxnId) -> bool:
+        i = self.index_of(txn_id)
+        if i < 0:
+            return False
+        self.applied_or_invalidated.set(i)
+        return self.waiting.unset(i)
+
+    def next_waiting(self) -> Optional[TxnId]:
+        """Lowest still-waiting dep (the NotifyWaitingOn walker chases this)."""
+        i = self.waiting.first_set()
+        return self.txn_ids[i] if i >= 0 else None
+
+    def waiting_ids(self) -> List[TxnId]:
+        return [self.txn_ids[i] for i in self.waiting]
+
+    def __repr__(self):
+        return f"WaitingOn({self.waiting_ids()!r})"
+
+
+class TransientListener:
+    """Non-durable callback registered on a command (e.g. ReadData waiting for
+    ReadyToExecute). Reference Command.TransientListener (Command.java:72-90)."""
+
+    def on_change(self, safe_store, command: "Command") -> None:
+        raise NotImplementedError
+
+
+class Command:
+    __slots__ = (
+        "txn_id", "save_status", "durability",
+        "route", "partial_txn", "execute_at", "execute_at_least",
+        "promised", "accepted_ballot",
+        "partial_deps", "stable_deps", "waiting_on",
+        "writes", "result",
+        "listeners", "transient_listeners",
+    )
+
+    def __init__(self, txn_id: TxnId):
+        self.txn_id = txn_id
+        self.save_status = SaveStatus.NOT_DEFINED
+        self.durability = Durability.NOT_DURABLE
+        self.route: Optional[Route] = None
+        self.partial_txn: Optional[PartialTxn] = None
+        self.execute_at: Optional[Timestamp] = None
+        self.execute_at_least: Optional[Timestamp] = None
+        self.promised: Ballot = Ballot.ZERO
+        self.accepted_ballot: Ballot = Ballot.ZERO
+        self.partial_deps: Optional[Deps] = None   # proposed (Accept round)
+        self.stable_deps: Optional[Deps] = None    # stable (Commit round)
+        self.waiting_on: Optional[WaitingOn] = None
+        self.writes: Optional[Writes] = None
+        self.result = None
+        self.listeners: Set[TxnId] = set()         # durable: commands waiting on us
+        self.transient_listeners: List[TransientListener] = []
+
+    # -- status predicates --
+    @property
+    def status(self) -> SaveStatus:
+        return self.save_status
+
+    def has_been(self, status: SaveStatus) -> bool:
+        return self.save_status >= status
+
+    @property
+    def is_defined(self) -> bool:
+        return self.save_status.is_defined and self.partial_txn is not None
+
+    @property
+    def is_stable(self) -> bool:
+        return self.save_status.is_at_least_stable
+
+    @property
+    def is_applied_or_gone(self) -> bool:
+        return (self.save_status.is_applied_or_gone
+                or self.save_status == SaveStatus.INVALIDATED)
+
+    @property
+    def is_truncated(self) -> bool:
+        return self.save_status.is_truncated
+
+    @property
+    def is_invalidated(self) -> bool:
+        return self.save_status == SaveStatus.INVALIDATED
+
+    def known(self) -> Known:
+        return self.save_status.known()
+
+    def execute_at_or_txn_id(self) -> Timestamp:
+        return self.execute_at if self.execute_at is not None else self.txn_id
+
+    # -- ballot gates (promise protocol; Command.java preacceptedOrLater etc.) --
+    def may_accept(self, ballot: Ballot) -> bool:
+        return self.promised <= ballot
+
+    def may_promise(self, ballot: Ballot) -> bool:
+        return self.promised < ballot or (self.promised == ballot)
+
+    def set_promised(self, ballot: Ballot) -> None:
+        invariants.check_state(ballot >= self.promised,
+                               "promise may only advance")
+        self.promised = ballot
+
+    # -- status transition (called only from local.commands) --
+    def set_status(self, status: SaveStatus) -> None:
+        if status < self.save_status:
+            # regressions are only legal into cleanup states
+            invariants.check_state(
+                status.is_truncated,
+                "illegal status regression %s -> %s for %s",
+                self.save_status.name, status.name, self.txn_id)
+        self.save_status = status
+
+    def update_route(self, route: Optional[Route]) -> None:
+        if route is None:
+            return
+        if self.route is None:
+            self.route = route
+        elif route.is_full and not self.route.is_full:
+            self.route = route
+
+    # -- listeners --
+    def add_listener(self, waiter: TxnId) -> None:
+        self.listeners.add(waiter)
+
+    def remove_listener(self, waiter: TxnId) -> None:
+        self.listeners.discard(waiter)
+
+    def add_transient_listener(self, listener: TransientListener) -> None:
+        self.transient_listeners.append(listener)
+
+    def remove_transient_listener(self, listener: TransientListener) -> None:
+        try:
+            self.transient_listeners.remove(listener)
+        except ValueError:
+            pass
+
+    def __repr__(self):
+        return (f"Command({self.txn_id!r}, {self.save_status.name}, "
+                f"at={self.execute_at!r})")

@@ -1,0 +1,448 @@
+"""All command state transitions, as static functions over a SafeCommandStore.
+
+Reference: accord/local/Commands.java — preaccept (:131), accept (:219),
+acceptInvalidate (:267), commit (:306), precommit (:371), commitInvalidate
+(:463), apply (:491), maybeExecute (:656), initialiseWaitingOn (:735),
+updateWaitingOn (:776), updateDependencyAndMaybeExecute (:832), truncation
+(:879-967), setDurability (:978).
+
+The WaitingOn graph walk these functions drive is north-star kernel #2: the
+batched device equivalent (topological wavefront over the conflict graph) lives
+in accord_tpu.ops.wavefront with this scalar path as its oracle.
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+from accord_tpu.local.cfk import InternalStatus
+from accord_tpu.local.command import Command, WaitingOn
+from accord_tpu.local.status import Durability, SaveStatus
+from accord_tpu.local.store import SafeCommandStore
+from accord_tpu.primitives.deps import Deps, KeyDeps
+from accord_tpu.primitives.keys import Key, Keys, Ranges, Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.txn import PartialTxn
+from accord_tpu.primitives.writes import Writes
+from accord_tpu.utils import invariants
+
+
+class AcceptOutcome(enum.Enum):
+    SUCCESS = "SUCCESS"
+    REDUNDANT = "REDUNDANT"          # already progressed past this phase
+    REJECTED_BALLOT = "REJECTED_BALLOT"
+    INSUFFICIENT = "INSUFFICIENT"
+    TRUNCATED = "TRUNCATED"
+
+
+class ApplyOutcome(enum.Enum):
+    SUCCESS = "SUCCESS"
+    REDUNDANT = "REDUNDANT"
+    INSUFFICIENT = "INSUFFICIENT"
+
+
+# ---------------------------------------------------------------- deps calc --
+
+def calculate_deps(safe_store: SafeCommandStore, txn_id: TxnId, keys: Keys,
+                   before: Timestamp) -> Deps:
+    """Dependency set for txn_id over `keys` (owned slice): every active
+    conflicting txn with id < `before` (PreAccept.calculatePartialDeps ->
+    CommandsForKey.mapReduceActive)."""
+    builder = KeyDeps.builder()
+    kinds = txn_id.kind.witnesses()
+
+    def visit(key: Key, dep: TxnId):
+        if dep != txn_id:
+            builder.add(key, dep)
+
+    safe_store.map_reduce_active(keys, before, kinds, visit)
+    return Deps(builder.build(), None)
+
+
+def propose_execute_at(safe_store: SafeCommandStore, txn_id: TxnId,
+                       participants) -> Timestamp:
+    """executeAt proposal: txn_id itself when no conflict is newer (fast-path
+    vote), else a fresh HLC strictly after every known conflict
+    (Commands.preaccept executeAt selection via MaxConflicts/TimestampsForKey)."""
+    max_conflict = safe_store.max_conflict(participants)
+    if max_conflict is None or max_conflict < txn_id:
+        return txn_id
+    return safe_store.node.unique_now_at_least(max_conflict)
+
+
+# ---------------------------------------------------------------- preaccept --
+
+def preaccept(safe_store: SafeCommandStore, txn_id: TxnId,
+              partial_txn: Optional[PartialTxn], route: Route,
+              ballot: Ballot = Ballot.ZERO
+              ) -> Tuple[AcceptOutcome, Optional[Timestamp]]:
+    """Witness the txn; propose executeAt (Commands.preaccept :131)."""
+    cmd = safe_store.get(txn_id)
+    if cmd.is_truncated or cmd.is_invalidated:
+        return AcceptOutcome.TRUNCATED, None
+    if not cmd.may_accept(ballot):
+        return AcceptOutcome.REJECTED_BALLOT, None
+    if cmd.has_been(SaveStatus.PRE_ACCEPTED):
+        # replay/recovery: return the previously witnessed timestamp
+        return AcceptOutcome.REDUNDANT, cmd.execute_at_or_txn_id()
+
+    cmd.update_route(route)
+    if partial_txn is not None:
+        cmd.partial_txn = partial_txn
+    participants = (partial_txn.keys if partial_txn is not None
+                    else route.participants())
+    witnessed_at = propose_execute_at(safe_store, txn_id, participants)
+    cmd.execute_at = witnessed_at
+    cmd.set_status(SaveStatus.PRE_ACCEPTED)
+    safe_store.update_max_conflicts(participants, txn_id)
+    safe_store.register(cmd, InternalStatus.PREACCEPTED)
+    if txn_id.is_range_domain and partial_txn is not None:
+        safe_store.register_range_txn(cmd, partial_txn.keys)
+    safe_store.progress_log.update(safe_store.store, txn_id, cmd)
+    return AcceptOutcome.SUCCESS, witnessed_at
+
+
+# ------------------------------------------------------------------- accept --
+
+def accept(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot,
+           route: Route, participating_keys, execute_at: Timestamp,
+           partial_deps: Deps) -> AcceptOutcome:
+    """Slow-path acceptance of (executeAt, deps) at `ballot`
+    (Commands.accept :219)."""
+    cmd = safe_store.get(txn_id)
+    if cmd.is_truncated or cmd.is_invalidated:
+        return AcceptOutcome.TRUNCATED
+    if not cmd.may_accept(ballot):
+        return AcceptOutcome.REJECTED_BALLOT
+    if cmd.has_been(SaveStatus.PRE_COMMITTED):
+        return AcceptOutcome.REDUNDANT
+
+    cmd.update_route(route)
+    cmd.set_promised(ballot)
+    cmd.accepted_ballot = ballot
+    cmd.execute_at = execute_at
+    cmd.partial_deps = partial_deps
+    cmd.set_status(SaveStatus.ACCEPTED)
+    safe_store.update_max_conflicts(participating_keys, execute_at)
+    safe_store.register(cmd, InternalStatus.ACCEPTED)
+    safe_store.progress_log.update(safe_store.store, txn_id, cmd)
+    return AcceptOutcome.SUCCESS
+
+
+def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId,
+                      ballot: Ballot) -> AcceptOutcome:
+    """Promise to invalidate (Commands.acceptInvalidate :267)."""
+    cmd = safe_store.get(txn_id)
+    if cmd.is_truncated:
+        return AcceptOutcome.TRUNCATED
+    if not cmd.may_accept(ballot):
+        return AcceptOutcome.REJECTED_BALLOT
+    if cmd.has_been(SaveStatus.PRE_COMMITTED):
+        return AcceptOutcome.REDUNDANT
+    cmd.set_promised(ballot)
+    cmd.accepted_ballot = ballot
+    if cmd.save_status < SaveStatus.ACCEPTED_INVALIDATE:
+        cmd.set_status(SaveStatus.ACCEPTED_INVALIDATE)
+    return AcceptOutcome.SUCCESS
+
+
+# ------------------------------------------------------------------- commit --
+
+def commit(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
+           partial_txn: Optional[PartialTxn], execute_at: Timestamp,
+           deps: Deps, stable: bool, ballot: Ballot = Ballot.ZERO
+           ) -> AcceptOutcome:
+    """Commit (executeAt, deps); `stable=True` also freezes deps and starts
+    execution tracking (Commands.commit :306)."""
+    cmd = safe_store.get(txn_id)
+    if cmd.is_truncated:
+        return AcceptOutcome.TRUNCATED
+    if cmd.is_invalidated:
+        safe_store.agent.on_inconsistent_timestamp(cmd, None, execute_at)
+        return AcceptOutcome.TRUNCATED
+    target = SaveStatus.STABLE if stable else SaveStatus.COMMITTED
+    if cmd.has_been(target):
+        if cmd.execute_at is not None and cmd.execute_at != execute_at \
+                and cmd.save_status.is_committed_to_execute:
+            safe_store.agent.on_inconsistent_timestamp(cmd, cmd.execute_at,
+                                                       execute_at)
+        return AcceptOutcome.REDUNDANT
+
+    cmd.update_route(route)
+    if partial_txn is not None and cmd.partial_txn is None:
+        cmd.partial_txn = partial_txn
+    if stable and cmd.partial_txn is None and _needs_definition(cmd):
+        return AcceptOutcome.INSUFFICIENT
+    cmd.execute_at = execute_at
+    if not stable:
+        cmd.partial_deps = deps
+        cmd.set_status(SaveStatus.COMMITTED)
+        safe_store.register(cmd, InternalStatus.COMMITTED)
+        safe_store.progress_log.update(safe_store.store, txn_id, cmd)
+        return AcceptOutcome.SUCCESS
+
+    cmd.stable_deps = deps
+    cmd.set_status(SaveStatus.STABLE)
+    safe_store.update_max_conflicts(
+        cmd.partial_txn.keys if cmd.partial_txn is not None
+        else route.participants(), execute_at)
+    safe_store.register(cmd, InternalStatus.STABLE)
+    initialise_waiting_on(safe_store, cmd)
+    safe_store.progress_log.update(safe_store.store, txn_id, cmd)
+    maybe_execute(safe_store, cmd, always_notify=True)
+    return AcceptOutcome.SUCCESS
+
+
+def _needs_definition(cmd: Command) -> bool:
+    """Sync points and data txns need their definition to execute; reads of
+    the definition come with the Stable/Apply message if missing."""
+    return cmd.txn_id.kind.is_globally_visible
+
+
+def precommit(safe_store: SafeCommandStore, txn_id: TxnId,
+              execute_at: Timestamp) -> AcceptOutcome:
+    """Record executeAt decision without deps (Commands.precommit :371)."""
+    cmd = safe_store.get(txn_id)
+    if cmd.is_truncated or cmd.is_invalidated:
+        return AcceptOutcome.TRUNCATED
+    if cmd.has_been(SaveStatus.PRE_COMMITTED):
+        return AcceptOutcome.REDUNDANT
+    cmd.execute_at = execute_at
+    cmd.set_status(SaveStatus.PRE_COMMITTED)
+    return AcceptOutcome.SUCCESS
+
+
+def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId) -> None:
+    """Finalize invalidation (Commands.commitInvalidate :463)."""
+    cmd = safe_store.get(txn_id)
+    if cmd.has_been(SaveStatus.COMMITTED) and not cmd.is_invalidated:
+        if cmd.save_status.is_committed_to_execute:
+            safe_store.agent.on_inconsistent_timestamp(cmd, cmd.execute_at, None)
+            return
+    if cmd.is_invalidated:
+        return
+    cmd.save_status = SaveStatus.INVALIDATED
+    safe_store.register(cmd, InternalStatus.INVALID_OR_TRUNCATED)
+    safe_store.progress_log.clear(txn_id)
+    _notify_listeners(safe_store, cmd)
+
+
+# -------------------------------------------------------------------- apply --
+
+def apply(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
+          execute_at: Timestamp, deps: Optional[Deps], writes: Optional[Writes],
+          result, partial_txn: Optional[PartialTxn] = None) -> ApplyOutcome:
+    """Record the outcome; execute once deps clear (Commands.apply :491)."""
+    cmd = safe_store.get(txn_id)
+    if cmd.has_been(SaveStatus.PRE_APPLIED) or cmd.is_truncated \
+            or cmd.is_invalidated:
+        return ApplyOutcome.REDUNDANT
+    if cmd.execute_at is not None and cmd.has_been(SaveStatus.PRE_COMMITTED) \
+            and cmd.execute_at != execute_at:
+        safe_store.agent.on_inconsistent_timestamp(cmd, cmd.execute_at, execute_at)
+
+    cmd.update_route(route)
+    if partial_txn is not None and cmd.partial_txn is None:
+        cmd.partial_txn = partial_txn
+    if not cmd.has_been(SaveStatus.STABLE):
+        if deps is None:
+            return ApplyOutcome.INSUFFICIENT
+        cmd.execute_at = execute_at
+        cmd.stable_deps = deps
+        cmd.set_status(SaveStatus.STABLE)
+        safe_store.register(cmd, InternalStatus.STABLE)
+        initialise_waiting_on(safe_store, cmd)
+    cmd.writes = writes
+    cmd.result = result
+    cmd.set_status(SaveStatus.PRE_APPLIED)
+    safe_store.progress_log.update(safe_store.store, txn_id, cmd)
+    maybe_execute(safe_store, cmd, always_notify=True)
+    return ApplyOutcome.SUCCESS
+
+
+# -------------------------------------------------- execution ordering core --
+
+def initialise_waiting_on(safe_store: SafeCommandStore, cmd: Command) -> None:
+    """Build the WaitingOn bitset over stable deps owned by this store and
+    register as listener on each still-blocking dep
+    (Commands.initialiseWaitingOn :735 + updateWaitingOn :776)."""
+    deps = cmd.stable_deps if cmd.stable_deps is not None else Deps.NONE
+    local = deps.slice(safe_store.ranges) if not safe_store.ranges.is_empty else deps
+    waiting_on = WaitingOn.from_deps(local)
+    cmd.waiting_on = waiting_on
+    for dep_id in list(waiting_on.txn_ids):
+        _update_waiting_on_dep(safe_store, cmd, dep_id)
+
+
+def _update_waiting_on_dep(safe_store: SafeCommandStore, cmd: Command,
+                           dep_id: TxnId) -> None:
+    """Evaluate one dep: clear it if terminal or ordered after us; otherwise
+    listen for its transitions (Commands.shouldWaitOn semantics)."""
+    waiting_on = cmd.waiting_on
+    if waiting_on is None or not waiting_on.is_waiting_on(dep_id):
+        return
+    dep = safe_store.get(dep_id)
+    if dep.is_applied_or_gone or dep.is_truncated:
+        waiting_on.set_applied_or_invalidated(dep_id)
+        return
+    # redundant (GC'd / pre-bootstrap) deps need not be waited for
+    if _is_redundant_dep(safe_store, cmd, dep_id):
+        waiting_on.set_applied_or_invalidated(dep_id)
+        return
+    if dep.save_status.is_committed_to_execute and cmd.execute_at is not None \
+            and dep.execute_at is not None and dep.execute_at > cmd.execute_at:
+        # ordered after us; not our problem
+        waiting_on.remove_waiting_on(dep_id)
+        dep.remove_listener(cmd.txn_id)
+        return
+    dep.add_listener(cmd.txn_id)
+    if not dep.has_been(SaveStatus.COMMITTED):
+        safe_store.progress_log.waiting(
+            dep_id, safe_store.store, "Committed", dep.route,
+            cmd.route.participants() if cmd.route else None)
+
+
+def _is_redundant_dep(safe_store: SafeCommandStore, cmd: Command,
+                      dep_id: TxnId) -> bool:
+    rb = safe_store.store.redundant_before
+    participants = None
+    dep = safe_store.store.commands.get(dep_id)
+    if dep is not None and dep.route is not None:
+        participants = dep.route.participants()
+    if participants is None or isinstance(participants, Ranges):
+        # conservative for range-domain deps: never skip
+        return False
+    return len(participants) > 0 and all(
+        rb.is_redundant(dep_id, k) for k in participants)
+
+
+def update_dependency_and_maybe_execute(safe_store: SafeCommandStore,
+                                        waiter: Command, dep: Command) -> None:
+    """A dep transitioned; re-evaluate and maybe unblock the waiter
+    (Commands.updateDependencyAndMaybeExecute :832)."""
+    if waiter.has_been(SaveStatus.APPLIED) or waiter.waiting_on is None:
+        return
+    if dep.is_applied_or_gone or dep.is_truncated:
+        if waiter.waiting_on.set_applied_or_invalidated(dep.txn_id):
+            dep.remove_listener(waiter.txn_id)
+            maybe_execute(safe_store, waiter, always_notify=False)
+    else:
+        _update_waiting_on_dep(safe_store, waiter, dep.txn_id)
+        if not waiter.waiting_on.is_waiting:
+            maybe_execute(safe_store, waiter, always_notify=False)
+
+
+def maybe_execute(safe_store: SafeCommandStore, cmd: Command,
+                  always_notify: bool) -> bool:
+    """Advance Stable->ReadyToExecute->apply when the WaitingOn set clears
+    (Commands.maybeExecute :656)."""
+    if cmd.save_status not in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
+        if always_notify:
+            _notify_listeners(safe_store, cmd)
+        return False
+    if cmd.waiting_on is not None and cmd.waiting_on.is_waiting:
+        if always_notify:
+            _notify_listeners(safe_store, cmd)
+        return False
+
+    if cmd.save_status == SaveStatus.STABLE:
+        cmd.set_status(SaveStatus.READY_TO_EXECUTE)
+        safe_store.progress_log.update(safe_store.store, cmd.txn_id, cmd)
+        _notify_listeners(safe_store, cmd)
+        return True
+
+    # PRE_APPLIED with no outstanding deps: run the writes
+    cmd.set_status(SaveStatus.APPLYING)
+    _apply_writes(safe_store, cmd)
+    return True
+
+
+def _apply_writes(safe_store: SafeCommandStore, cmd: Command) -> None:
+    """Writes.apply against the DataStore, then postApply (Commands.applyChain
+    :565-654)."""
+    store = safe_store.store
+
+    def post_apply(_v=None, failure=None):
+        if failure is not None:
+            safe_store.agent.on_uncaught_exception(failure)
+            return
+        # record execution timestamps per owned key
+        for key in safe_store.owned_keys_of(cmd):
+            tfk = safe_store.tfk(key)
+            tfk.on_executed(cmd.execute_at, cmd.txn_id.kind.is_write)
+        cmd.set_status(SaveStatus.APPLIED)
+        safe_store.register(cmd, InternalStatus.APPLIED)
+        safe_store.progress_log.update(store, cmd.txn_id, cmd)
+        store.node.events.on_applied(cmd)
+        _notify_listeners(safe_store, cmd)
+
+    if cmd.writes is None or cmd.writes.is_empty:
+        post_apply()
+    else:
+        within = safe_store.ranges if not safe_store.ranges.is_empty else None
+        cmd.writes.apply(store.data_store, within).add_callback(post_apply)
+
+
+def _notify_listeners(safe_store: SafeCommandStore, cmd: Command) -> None:
+    """Notify durable (dependent commands) and transient listeners of a
+    transition. Re-entrant calls enqueue onto the store-level drain queue so
+    arbitrarily deep apply cascades use constant stack (the reference's
+    NotifyWaitingOn walker, Commands.java:1011, achieves the same by running
+    each step as a separate executor task)."""
+    store = safe_store.store
+    store.notify_queue.append(cmd.txn_id)
+    if store.notifying:
+        return
+    store.notifying = True
+    try:
+        while store.notify_queue:
+            tid = store.notify_queue.popleft()
+            c = store.commands.get(tid)
+            if c is None:
+                continue
+            for listener in list(c.transient_listeners):
+                listener.on_change(safe_store, c)
+            for waiter_id in sorted(c.listeners):
+                waiter = store.commands.get(waiter_id)
+                if waiter is None:
+                    c.listeners.discard(waiter_id)
+                    continue
+                update_dependency_and_maybe_execute(safe_store, waiter, c)
+    finally:
+        store.notifying = False
+
+
+# --------------------------------------------------------------- durability --
+
+def set_durability(safe_store: SafeCommandStore, txn_id: TxnId,
+                   durability: Durability) -> None:
+    """(Commands.setDurability :978)"""
+    cmd = safe_store.get(txn_id)
+    if durability > cmd.durability:
+        cmd.durability = durability
+        safe_store.progress_log.durable(cmd)
+
+
+# --------------------------------------------------------------- truncation --
+
+def purge(safe_store: SafeCommandStore, txn_id: TxnId,
+          erase: bool = False) -> None:
+    """Truncate a durably-applied (or invalidated) command's local state
+    (Commands.purge :879-967)."""
+    cmd = safe_store.get(txn_id)
+    invariants.check_state(
+        cmd.is_applied_or_gone or cmd.durability.is_durable,
+        "cannot purge %s in state %s", txn_id, cmd.save_status.name)
+    cmd.partial_txn = None
+    cmd.partial_deps = None
+    cmd.stable_deps = None
+    cmd.waiting_on = None
+    cmd.writes = None
+    cmd.result = None
+    if cmd.is_invalidated:
+        pass  # keep INVALIDATED as terminal state
+    else:
+        cmd.save_status = SaveStatus.ERASED if erase else SaveStatus.TRUNCATED_APPLY
+    _notify_listeners(safe_store, cmd)

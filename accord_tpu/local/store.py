@@ -1,0 +1,402 @@
+"""CommandStore / SafeCommandStore / CommandStores: the sharded replica state.
+
+Reference: accord/local/CommandStore.java:80-727 (single-threaded metadata
+shard), SafeCommandStore.java:56+ (the transactional view and conflict query
+API), CommandStores.java:78-726 (range-sharded fan-out with map-reduce),
+ShardDistributor.EvenSplit (ShardDistributor.java:33-46), PreLoadContext
+(PreLoadContext.java:42).
+
+Intra-node parallelism model is the reference's: the node's owned keyspace is
+split over N logically single-threaded CommandStore shards; every operation
+declares what it touches (PreLoadContext) and runs on each intersecting shard
+via `execute`, with replies reduced across shards.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, TYPE_CHECKING
+
+from accord_tpu.local.cfk import CommandsForKey, InternalStatus, TimestampsForKey, Unmanaged
+from accord_tpu.local.command import Command
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.local.watermarks import DurableBefore, MaxConflicts, RedundantBefore
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keys import Key, Keys, Range, Ranges, RoutingKey, _SortedKeyList
+from accord_tpu.primitives.timestamp import KindSet, Timestamp, TxnId
+from accord_tpu.utils import invariants
+from accord_tpu.utils.async_chains import AsyncResult
+
+if TYPE_CHECKING:
+    from accord_tpu.api.spi import Agent, DataStore, ProgressLog
+
+
+class PreLoadContext:
+    """Declares the TxnIds/keys an operation touches so async store
+    implementations can page them in (PreLoadContext.java:42). The in-memory
+    store ignores it; the simulator uses it to model cache-miss delays."""
+
+    __slots__ = ("txn_ids", "keys")
+
+    def __init__(self, txn_ids: Sequence[TxnId] = (), keys=None):
+        self.txn_ids = tuple(txn_ids)
+        self.keys = keys if keys is not None else Keys(())
+
+    @classmethod
+    def empty(cls) -> "PreLoadContext":
+        return cls()
+
+    @classmethod
+    def for_txn(cls, txn_id: TxnId, keys=None) -> "PreLoadContext":
+        return cls((txn_id,), keys)
+
+
+class SafeCommandStore:
+    """The view handed to operations executing on a shard
+    (SafeCommandStore.java:56). Provides command access, CFK registration, and
+    the conflict query API (mapReduceActive / recovery scans)."""
+
+    def __init__(self, store: "CommandStore", context: PreLoadContext):
+        self.store = store
+        self.context = context
+
+    # -- command access --
+    def get(self, txn_id: TxnId) -> Command:
+        return self.store._get_or_create(txn_id)
+
+    def if_present(self, txn_id: TxnId) -> Optional[Command]:
+        return self.store.commands.get(txn_id)
+
+    def if_initialised(self, txn_id: TxnId) -> Optional[Command]:
+        c = self.store.commands.get(txn_id)
+        return c if c is not None and c.save_status != SaveStatus.NOT_DEFINED \
+            else None
+
+    # -- environment --
+    @property
+    def ranges(self) -> Ranges:
+        return self.store.ranges
+
+    @property
+    def agent(self) -> "Agent":
+        return self.store.agent
+
+    @property
+    def data_store(self) -> "DataStore":
+        return self.store.data_store
+
+    @property
+    def progress_log(self) -> "ProgressLog":
+        return self.store.progress_log
+
+    @property
+    def node(self):
+        return self.store.node
+
+    def time_now(self) -> Timestamp:
+        return self.store.unique_now()
+
+    # -- CFK maintenance --
+    def cfk(self, key: Key) -> CommandsForKey:
+        return self.store._cfk(key)
+
+    def tfk(self, key: Key) -> TimestampsForKey:
+        return self.store._tfk(key)
+
+    def owned_keys_of(self, command: Command) -> Keys:
+        """The command's participating data keys owned by this store."""
+        if command.partial_txn is not None and isinstance(command.partial_txn.keys, Keys):
+            return command.partial_txn.keys.slice(self.ranges)
+        if command.route is not None and command.route.is_key_domain:
+            return Keys([Key(k.token) for k in command.route.keys]).slice(self.ranges)
+        return Keys(())
+
+    def register(self, command: Command, status: InternalStatus) -> None:
+        """Reflect a command transition into every owned CFK
+        (SafeCommandStore registration / CommandsForKey.update)."""
+        if command.txn_id.is_range_domain:
+            return  # range txns are tracked via rangeCommands, not per-key CFK
+        for key in self.owned_keys_of(command):
+            self.cfk(key).update(command.txn_id, status, command.execute_at)
+
+    def register_range_txn(self, command: Command, ranges: Ranges) -> None:
+        self.store.range_commands[command.txn_id] = ranges.slice(self.ranges) \
+            if not self.ranges.is_empty else ranges
+
+    # -- conflict queries --
+    def map_reduce_active(self, keys: Keys, before: Timestamp, kinds: KindSet,
+                          fn: Callable[[Key, TxnId], None]) -> None:
+        """Per-key active-conflict scan: the deps calculation
+        (SafeCommandStore.mapReduceActive -> CommandsForKey.mapReduceActive)."""
+        owned = keys.slice(self.ranges)
+        for key in owned:
+            cfk = self.store.cfks.get(key)
+            if cfk is not None:
+                cfk.map_reduce_active(before, kinds, lambda t, k=key: fn(k, t))
+        # range-domain txns intersecting these keys are conflicts too
+        for txn_id, ranges in self.store.range_commands.items():
+            cmd = self.store.commands.get(txn_id)
+            if cmd is None or cmd.save_status == SaveStatus.INVALIDATED \
+                    or cmd.is_truncated:
+                continue
+            if txn_id >= before or txn_id.kind not in kinds:
+                continue
+            for key in owned:
+                if ranges.contains(key):
+                    fn(key, txn_id)
+
+    def max_conflict(self, participants) -> Optional[Timestamp]:
+        return self.store.max_conflicts.get(participants)
+
+    def update_max_conflicts(self, participants, ts: Timestamp) -> None:
+        self.store.max_conflicts.update(participants, ts)
+
+    def _witnessed_by(self, by: TxnId, target: TxnId) -> bool:
+        """Does `by`'s dependency set include `target`?"""
+        cmd = self.store.commands.get(by)
+        if cmd is None:
+            return False
+        for deps in (cmd.stable_deps, cmd.partial_deps):
+            if deps is not None and deps.contains(target):
+                return True
+        return False
+
+    # recovery predicates (BeginRecovery.java:104-190 via mapReduceFull)
+    def rejects_fast_path(self, txn_id: TxnId, keys: Keys) -> bool:
+        wb = lambda t: self._witnessed_by(t, txn_id)
+        for key in keys.slice(self.ranges):
+            cfk = self.store.cfks.get(key)
+            if cfk is None:
+                continue
+            if cfk.accepted_or_committed_started_after_without_witnessing(txn_id, wb):
+                return True
+            if cfk.committed_executes_after_without_witnessing(txn_id, wb):
+                return True
+        return False
+
+    def earlier_committed_witness(self, txn_id: TxnId, keys: Keys) -> List[TxnId]:
+        wb = lambda t: self._witnessed_by(t, txn_id)
+        out: Set[TxnId] = set()
+        for key in keys.slice(self.ranges):
+            cfk = self.store.cfks.get(key)
+            if cfk is not None:
+                out.update(cfk.stable_started_before_and_witnessed(txn_id, wb))
+        return sorted(out)
+
+    def earlier_accepted_no_witness(self, txn_id: TxnId, keys: Keys) -> List[TxnId]:
+        wb = lambda t: self._witnessed_by(t, txn_id)
+        out: Set[TxnId] = set()
+        for key in keys.slice(self.ranges):
+            cfk = self.store.cfks.get(key)
+            if cfk is not None:
+                out.update(
+                    cfk.accepted_or_committed_started_before_without_witnessing(
+                        txn_id, wb))
+        return sorted(out)
+
+
+class CommandStore:
+    """One logically single-threaded metadata shard (CommandStore.java:80).
+
+    `execute(context, fn)` is the only entry point for mutations; the base
+    implementation runs inline (synchronous in-memory store). Subclasses
+    (accord_tpu.impl / the simulator's DelayedCommandStore) override
+    `_submit` to add executor hops, async-load delays, and thread checks.
+    """
+
+    def __init__(self, store_id: int, node, ranges: Ranges):
+        self.id = store_id
+        self.node = node
+        self.ranges = ranges
+        self.commands: Dict[TxnId, Command] = {}
+        self.cfks: Dict[Key, CommandsForKey] = {}
+        self.tfks: Dict[Key, TimestampsForKey] = {}
+        self.range_commands: Dict[TxnId, Ranges] = {}
+        self.max_conflicts = MaxConflicts()
+        self.redundant_before = RedundantBefore()
+        self.durable_before = DurableBefore()
+        # listener-notification drain queue (see commands._notify_listeners)
+        from collections import deque
+        self.notify_queue = deque()
+        self.notifying = False
+
+    # -- environment plumbing --
+    @property
+    def agent(self):
+        return self.node.agent
+
+    @property
+    def data_store(self):
+        return self.node.data_store
+
+    @property
+    def progress_log(self):
+        return self.node.progress_log_for(self)
+
+    def unique_now(self) -> Timestamp:
+        return self.node.unique_now()
+
+    # -- state access (only from within execute) --
+    def _get_or_create(self, txn_id: TxnId) -> Command:
+        cmd = self.commands.get(txn_id)
+        if cmd is None:
+            cmd = self.commands[txn_id] = Command(txn_id)
+        return cmd
+
+    def _cfk(self, key: Key) -> CommandsForKey:
+        cfk = self.cfks.get(key)
+        if cfk is None:
+            cfk = self.cfks[key] = CommandsForKey(key)
+        return cfk
+
+    def _tfk(self, key: Key) -> TimestampsForKey:
+        tfk = self.tfks.get(key)
+        if tfk is None:
+            tfk = self.tfks[key] = TimestampsForKey(key)
+        return tfk
+
+    # -- execution --
+    def execute(self, context: PreLoadContext,
+                fn: Callable[[SafeCommandStore], None]) -> None:
+        self._submit(context, fn, None)
+
+    def submit(self, context: PreLoadContext,
+               fn: Callable[[SafeCommandStore], object]) -> AsyncResult:
+        result: AsyncResult = AsyncResult()
+        self._submit(context, fn, result)
+        return result
+
+    def _submit(self, context: PreLoadContext, fn, result: Optional[AsyncResult]
+                ) -> None:
+        """Base: run inline. Overridden by async/simulated stores."""
+        try:
+            value = fn(SafeCommandStore(self, context))
+        except BaseException as e:  # noqa: BLE001
+            if result is not None:
+                result.set_failure(e)
+            else:
+                self.agent.on_uncaught_exception(e)
+            return
+        if result is not None:
+            result.set_success(value)
+
+    def update_ranges(self, ranges: Ranges) -> None:
+        self.ranges = ranges
+
+    def __repr__(self):
+        return f"CommandStore#{self.id}({self.ranges!r})"
+
+
+class EvenSplit:
+    """ShardDistributor.EvenSplit: split owned token span evenly over N shards
+    (ShardDistributor.java:33-46)."""
+
+    def __init__(self, count: int):
+        invariants.check_argument(count > 0, "need at least one shard")
+        self.count = count
+
+    def split(self, ranges: Ranges) -> List[Ranges]:
+        total = sum(r.end - r.start for r in ranges)
+        if total == 0 or self.count == 1:
+            return [ranges] + [Ranges.EMPTY] * (self.count - 1)
+        out: List[Ranges] = []
+        per = total / self.count
+        flat: List[Range] = list(ranges)
+        acc: List[Range] = []
+        acc_len = 0
+        target = per
+        taken = 0
+        for r in flat:
+            start = r.start
+            while start < r.end:
+                remaining_here = r.end - start
+                need = target - (taken + acc_len)
+                if remaining_here <= need or len(out) == self.count - 1:
+                    acc.append(Range(start, r.end))
+                    acc_len += r.end - start
+                    start = r.end
+                else:
+                    take = max(1, int(need))
+                    acc.append(Range(start, start + take))
+                    acc_len += take
+                    start += take
+                    taken += acc_len
+                    out.append(Ranges(acc, _normalized=True))
+                    acc, acc_len = [], 0
+                    target = per * (len(out) + 1)
+        out.append(Ranges(acc))
+        while len(out) < self.count:
+            out.append(Ranges.EMPTY)
+        return out[:self.count]
+
+
+class CommandStores:
+    """The node's shard manager (CommandStores.java:78): owns N CommandStores
+    over an EvenSplit of the node's ranges; fans operations out over
+    intersecting shards and chains the reduce."""
+
+    def __init__(self, node, num_shards: int = 1,
+                 store_factory: Callable[[int, object, Ranges], CommandStore] = None):
+        self.node = node
+        self.num_shards = num_shards
+        self.store_factory = store_factory or CommandStore
+        self.stores: List[CommandStore] = []
+        self._splitter = EvenSplit(num_shards)
+
+    def initialize(self, ranges: Ranges) -> None:
+        splits = self._splitter.split(ranges)
+        self.stores = [self.store_factory(i, self.node, splits[i])
+                       for i in range(self.num_shards)]
+
+    def update_topology(self, ranges: Ranges) -> Ranges:
+        """Re-split on topology change; returns ranges newly added to this node
+        (which require bootstrap). Reference CommandStores.updateTopology
+        (:401-481) — our EvenSplit re-splits in place; stores keep their
+        existing state and simply gain/lose ranges."""
+        if not self.stores:
+            self.initialize(ranges)
+            return ranges
+        old = Ranges.EMPTY
+        for s in self.stores:
+            old = old.union(s.ranges)
+        splits = self._splitter.split(ranges)
+        for i, s in enumerate(self.stores):
+            s.update_ranges(splits[i])
+        return ranges.subtract(old)
+
+    def all(self) -> List[CommandStore]:
+        return list(self.stores)
+
+    def intersecting(self, participants) -> List[CommandStore]:
+        if participants is None:
+            return self.all()
+        out = []
+        for s in self.stores:
+            if s.ranges.is_empty:
+                continue
+            if isinstance(participants, _SortedKeyList):
+                if participants.intersects_ranges(s.ranges):
+                    out.append(s)
+            elif isinstance(participants, Ranges):
+                if s.ranges.intersects(participants):
+                    out.append(s)
+            else:
+                raise TypeError(type(participants))
+        return out
+
+    def for_each(self, context: PreLoadContext, participants,
+                 fn: Callable[[SafeCommandStore], None]) -> None:
+        for s in self.intersecting(participants):
+            s.execute(context, fn)
+
+    def map_reduce(self, context: PreLoadContext, participants,
+                   map_fn: Callable[[SafeCommandStore], object],
+                   reduce_fn: Callable[[object, object], object]) -> AsyncResult:
+        """Fan out over intersecting shards; chain the reduce
+        (CommandStores.mapReduceConsume, :546-640)."""
+        stores = self.intersecting(participants)
+        if not stores:
+            from accord_tpu.utils.async_chains import success
+            return success(None)
+        results = [s.submit(context, map_fn) for s in stores]
+        from accord_tpu.utils import async_chains
+        return async_chains.reduce(results, reduce_fn)

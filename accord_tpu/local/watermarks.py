@@ -1,0 +1,228 @@
+"""Per-store range-map watermarks: MaxConflicts, RedundantBefore, DurableBefore.
+
+Reference: accord/local/MaxConflicts.java:28, RedundantBefore.java:37-120,
+DurableBefore.java:39-180, all backed by ReducingRangeMap (SURVEY.md §2.3/§2.8).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from accord_tpu.primitives.keys import Keys, Ranges, RoutingKey, _SortedKeyList
+from accord_tpu.primitives.timestamp import Timestamp, TxnId, TXNID_NONE
+from accord_tpu.utils.interval_map import ReducingRangeMap
+
+
+class MaxConflicts:
+    """token-range -> max conflict Timestamp; consulted for executeAt proposal
+    (MaxConflicts.java:28)."""
+
+    def __init__(self):
+        self._map: ReducingRangeMap = ReducingRangeMap()
+
+    def get(self, participants) -> Optional[Timestamp]:
+        """Max conflict over a Keys/Ranges selection."""
+        best: Optional[Timestamp] = None
+        if isinstance(participants, _SortedKeyList):
+            for k in participants:
+                v = self._map.get(k.token)
+                if v is not None and (best is None or v > best):
+                    best = v
+        else:
+            for r in participants:
+                v = self._map.fold_max(r.start, r.end)
+                if v is not None and (best is None or v > best):
+                    best = v
+        return best
+
+    def update(self, participants, ts: Timestamp) -> None:
+        if isinstance(participants, _SortedKeyList):
+            for k in participants:
+                self._map = self._map.update(k.token, k.token + 1, ts, max)
+        else:
+            for r in participants:
+                self._map = self._map.update(r.start, r.end, ts, max)
+
+
+class PreBootstrapOrStale(enum.Enum):
+    """Classification of a TxnId against a range's bootstrap/staleness state
+    (RedundantBefore.PreBootstrapOrStale)."""
+
+    FULLY = "FULLY"
+    PARTIALLY = "PARTIALLY"
+    POST_BOOTSTRAP = "POST_BOOTSTRAP"
+
+
+class RedundantEntry:
+    """Per-range redundancy facts (RedundantBefore.Entry)."""
+
+    __slots__ = ("locally_applied_before", "shard_applied_before",
+                 "bootstrapped_at", "stale_until_at_least")
+
+    def __init__(self, locally_applied_before: TxnId = TXNID_NONE,
+                 shard_applied_before: TxnId = TXNID_NONE,
+                 bootstrapped_at: TxnId = TXNID_NONE,
+                 stale_until_at_least: Optional[Timestamp] = None):
+        self.locally_applied_before = locally_applied_before
+        self.shard_applied_before = shard_applied_before
+        self.bootstrapped_at = bootstrapped_at
+        self.stale_until_at_least = stale_until_at_least
+
+    @staticmethod
+    def merge(a: "RedundantEntry", b: "RedundantEntry") -> "RedundantEntry":
+        return RedundantEntry(
+            max(a.locally_applied_before, b.locally_applied_before),
+            max(a.shard_applied_before, b.shard_applied_before),
+            max(a.bootstrapped_at, b.bootstrapped_at),
+            Timestamp.non_null_or_max(a.stale_until_at_least,
+                                      b.stale_until_at_least))
+
+    def __eq__(self, other):
+        return (isinstance(other, RedundantEntry)
+                and self.locally_applied_before == other.locally_applied_before
+                and self.shard_applied_before == other.shard_applied_before
+                and self.bootstrapped_at == other.bootstrapped_at
+                and self.stale_until_at_least == other.stale_until_at_least)
+
+    def __repr__(self):
+        return (f"RedundantEntry(local<{self.locally_applied_before!r}, "
+                f"shard<{self.shard_applied_before!r}, "
+                f"boot@{self.bootstrapped_at!r})")
+
+
+class RedundantBefore:
+    """Range map of RedundantEntry: classifies TxnIds as live / redundant /
+    pre-bootstrap per range; prunes deps and gates GC (RedundantBefore.java)."""
+
+    def __init__(self):
+        self._map: ReducingRangeMap = ReducingRangeMap()
+
+    def _entry_for_key(self, key: RoutingKey) -> Optional[RedundantEntry]:
+        return self._map.get(key.token)
+
+    def update_locally_applied(self, ranges: Ranges, before: TxnId) -> None:
+        e = RedundantEntry(locally_applied_before=before)
+        for r in ranges:
+            self._map = self._map.update(r.start, r.end, e, RedundantEntry.merge)
+
+    def update_shard_applied(self, ranges: Ranges, before: TxnId) -> None:
+        e = RedundantEntry(shard_applied_before=before)
+        for r in ranges:
+            self._map = self._map.update(r.start, r.end, e, RedundantEntry.merge)
+
+    def set_bootstrapped_at(self, ranges: Ranges, at: TxnId) -> None:
+        e = RedundantEntry(bootstrapped_at=at)
+        for r in ranges:
+            self._map = self._map.update(r.start, r.end, e, RedundantEntry.merge)
+
+    def set_stale_until(self, ranges: Ranges, until: Timestamp) -> None:
+        e = RedundantEntry(stale_until_at_least=until)
+        for r in ranges:
+            self._map = self._map.update(r.start, r.end, e, RedundantEntry.merge)
+
+    def is_redundant(self, txn_id: TxnId, key: RoutingKey) -> bool:
+        e = self._entry_for_key(key)
+        return e is not None and txn_id < max(e.locally_applied_before,
+                                              e.bootstrapped_at)
+
+    def is_shard_redundant(self, txn_id: TxnId, key: RoutingKey) -> bool:
+        e = self._entry_for_key(key)
+        return e is not None and txn_id < e.shard_applied_before
+
+    def pre_bootstrap_or_stale(self, txn_id: TxnId, participants
+                               ) -> PreBootstrapOrStale:
+        """Is txn_id before the bootstrap fence / within a stale window for
+        (some of) its participants?"""
+        def probe(e: Optional[RedundantEntry]) -> bool:
+            return e is not None and (
+                txn_id < e.bootstrapped_at
+                or (e.stale_until_at_least is not None
+                    and txn_id < e.stale_until_at_least))
+
+        pre = post = False
+        if isinstance(participants, _SortedKeyList):
+            for k in participants:
+                if probe(self._entry_for_key(k)):
+                    pre = True
+                else:
+                    post = True
+        else:
+            # evaluate every map span intersecting each range, so a fence
+            # covering only part of the span is seen
+            for r in participants:
+                for s, e_, v in self._map.spans():
+                    inter = not ((e_ is not None and e_ <= r.start)
+                                 or (s is not None and s >= r.end))
+                    if not inter:
+                        continue
+                    if probe(v):
+                        pre = True
+                    else:
+                        post = True
+        if pre and not post:
+            return PreBootstrapOrStale.FULLY
+        if pre:
+            return PreBootstrapOrStale.PARTIALLY
+        return PreBootstrapOrStale.POST_BOOTSTRAP
+
+    def min_locally_applied_before(self, ranges: Ranges) -> TxnId:
+        """Floor watermark across `ranges` (for GC gating)."""
+        result: Optional[TxnId] = None
+        for r in ranges:
+            def fold(acc, s, e_, v):
+                return v.locally_applied_before if acc is None \
+                    else min(acc, v.locally_applied_before)
+            covered = self._map.fold(fold, None, r.start, r.end)
+            # any uncovered span means watermark is NONE
+            for s, e_, v in self._map.spans():
+                inter = not ((e_ is not None and e_ <= r.start)
+                             or (s is not None and s >= r.end))
+                if inter and v is None:
+                    return TXNID_NONE
+            if covered is None:
+                return TXNID_NONE
+            result = covered if result is None else min(result, covered)
+        return result if result is not None else TXNID_NONE
+
+
+class DurableBefore:
+    """Range map -> {majority_before, universal_before} TxnId durability bounds
+    (DurableBefore.java:39-180): NotDurable / MajorityOrInvalidated /
+    UniversalOrInvalidated classes for GC."""
+
+    class Entry:
+        __slots__ = ("majority_before", "universal_before")
+
+        def __init__(self, majority_before: TxnId = TXNID_NONE,
+                     universal_before: TxnId = TXNID_NONE):
+            self.majority_before = majority_before
+            self.universal_before = universal_before
+
+        @staticmethod
+        def merge_max(a: "DurableBefore.Entry", b: "DurableBefore.Entry"):
+            return DurableBefore.Entry(
+                max(a.majority_before, b.majority_before),
+                max(a.universal_before, b.universal_before))
+
+    def __init__(self):
+        self._map: ReducingRangeMap = ReducingRangeMap()
+
+    def update(self, ranges: Ranges, majority_before: TxnId,
+               universal_before: TxnId = TXNID_NONE) -> None:
+        e = DurableBefore.Entry(majority_before, universal_before)
+        for r in ranges:
+            self._map = self._map.update(r.start, r.end, e,
+                                         DurableBefore.Entry.merge_max)
+
+    def is_majority_durable(self, txn_id: TxnId, key: RoutingKey) -> bool:
+        e = self._map.get(key.token)
+        return e is not None and txn_id < e.majority_before
+
+    def is_universally_durable(self, txn_id: TxnId, key: RoutingKey) -> bool:
+        e = self._map.get(key.token)
+        return e is not None and txn_id < e.universal_before
+
+    def majority_before(self, key: RoutingKey) -> TxnId:
+        e = self._map.get(key.token)
+        return e.majority_before if e is not None else TXNID_NONE

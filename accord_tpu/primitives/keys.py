@@ -95,10 +95,6 @@ class _SortedKeyList:
         i = find_ceil(self._keys, key)
         return i < len(self._keys) and self._keys[i] == key
 
-    def index_of(self, key: RoutingKey) -> int:
-        i = find_ceil(self._keys, key)
-        return i if i < len(self._keys) and self._keys[i] == key else -(i + 1) - 0 - 1
-
     def find(self, key: RoutingKey) -> int:
         """Index of key, or -(insertion)-1."""
         i = find_ceil(self._keys, key)
@@ -218,13 +214,14 @@ class Ranges:
     """Sorted, deoverlapped range set. Reference primitives/Ranges.java /
     AbstractRanges.java."""
 
-    __slots__ = ("_ranges",)
+    __slots__ = ("_ranges", "_starts")
 
     def __init__(self, ranges: Iterable[Range] = (), _normalized: bool = False):
         rs = list(ranges)
         if not _normalized:
             rs = self._normalize(rs)
         self._ranges: Tuple[Range, ...] = tuple(rs)
+        self._starts: Tuple[int, ...] = tuple(r.start for r in rs)
 
     @staticmethod
     def _normalize(rs: List[Range]) -> List[Range]:
@@ -276,8 +273,7 @@ class Ranges:
         return self._find_containing(token) is not None
 
     def _find_containing(self, token: int) -> Optional[Range]:
-        starts = [r.start for r in self._ranges]
-        i = bisect.bisect_right(starts, token) - 1
+        i = bisect.bisect_right(self._starts, token) - 1
         if i >= 0 and self._ranges[i].contains_token(token):
             return self._ranges[i]
         return None
@@ -388,7 +384,7 @@ class Route:
         """Minimal Ranges covering the participants."""
         if self.ranges is not None:
             return self.ranges
-        return Ranges([Range(k.token, k.token + 1) for k in self.keys])
+        return self.keys.to_ranges()
 
     def slice(self, ranges: Ranges) -> "Route":
         if self.keys is not None:

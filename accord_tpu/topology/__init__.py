@@ -1,0 +1,6 @@
+"""Topology / membership layer (reference: accord/topology — SURVEY.md §2.6)."""
+
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.topology.manager import TopologyManager

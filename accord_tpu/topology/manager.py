@@ -1,0 +1,166 @@
+"""TopologyManager: the per-node epoch ledger and sync tracker.
+
+Reference: accord/topology/TopologyManager.java:70-671. Tracks every known
+epoch's topology, which peers have completed their inter-epoch sync (a
+per-shard quorum of sync acknowledgements unlocks the epoch for precise
+coordination), pending futures for unknown epochs, and the epoch-window
+selection used by coordinators (`with_unsynced_epochs` / `precise_epochs`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set
+
+from accord_tpu.primitives.keys import Ranges
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.topology.topology import Topology
+from accord_tpu.utils import invariants
+from accord_tpu.utils.async_chains import AsyncResult, success
+
+
+class EpochState:
+    __slots__ = ("global_topology", "synced_nodes", "sync_complete", "closed",
+                 "redundant")
+
+    def __init__(self, global_topology: Topology):
+        self.global_topology = global_topology
+        self.synced_nodes: Set[int] = set()
+        self.sync_complete = False
+        self.closed: Ranges = Ranges.EMPTY      # ranges no longer coordinated here
+        self.redundant: Ranges = Ranges.EMPTY   # ranges fully superseded
+
+    def recompute_sync(self) -> bool:
+        """Sync-complete when every shard has a (slow-path) quorum of synced
+        replicas (TopologyManager.onEpochSyncComplete quorum per shard)."""
+        if self.sync_complete:
+            return True
+        for shard in self.global_topology.shards:
+            acks = sum(1 for n in shard.nodes if n in self.synced_nodes)
+            if acks < shard.slow_path_quorum_size:
+                return False
+        self.sync_complete = True
+        return True
+
+
+class TopologyManager:
+    def __init__(self, node_id: int, sorter=None):
+        self.node_id = node_id
+        self.sorter = sorter
+        self._epochs: Dict[int, EpochState] = {}
+        self._min_epoch = 0
+        self._max_epoch = 0
+        self._pending: Dict[int, AsyncResult] = {}
+        self._fetch_hook: Optional[Callable[[int], None]] = None
+
+    # -- feeding --
+    def on_topology_update(self, topology: Topology) -> None:
+        epoch = topology.epoch
+        if self._max_epoch == 0:
+            self._min_epoch = epoch
+            # first epoch needs no predecessor sync
+            state = EpochState(topology)
+            state.sync_complete = True
+            self._epochs[epoch] = state
+        else:
+            invariants.check_argument(
+                epoch == self._max_epoch + 1,
+                "topology epochs must arrive in order (%d after %d)",
+                epoch, self._max_epoch)
+            self._epochs[epoch] = EpochState(topology)
+        self._max_epoch = max(self._max_epoch, epoch)
+        pending = self._pending.pop(epoch, None)
+        if pending is not None:
+            pending.try_success(topology)
+
+    def on_epoch_sync_complete(self, node: int, epoch: int) -> None:
+        """Peer `node` reports it finished syncing epoch `epoch`'s data."""
+        state = self._epochs.get(epoch)
+        if state is None:
+            return  # unknown epoch; acks for future epochs are re-broadcast
+        state.synced_nodes.add(node)
+        state.recompute_sync()
+
+    def on_epoch_closed(self, ranges: Ranges, epoch: int) -> None:
+        state = self._epochs.get(epoch)
+        if state is not None:
+            state.closed = state.closed.union(ranges)
+
+    def on_epoch_redundant(self, ranges: Ranges, epoch: int) -> None:
+        state = self._epochs.get(epoch)
+        if state is not None:
+            state.redundant = state.redundant.union(ranges)
+
+    def truncate_before(self, epoch: int) -> None:
+        for e in list(self._epochs):
+            if e < epoch:
+                del self._epochs[e]
+        self._min_epoch = max(self._min_epoch, epoch)
+
+    def set_fetch_hook(self, hook: Callable[[int], None]) -> None:
+        """Called when someone awaits an epoch we don't know (drives
+        ConfigurationService.fetchTopologyForEpoch)."""
+        self._fetch_hook = hook
+
+    # -- queries --
+    @property
+    def epoch(self) -> int:
+        return self._max_epoch
+
+    @property
+    def min_epoch(self) -> int:
+        return self._min_epoch
+
+    def has_epoch(self, epoch: int) -> bool:
+        return epoch in self._epochs
+
+    def current(self) -> Topology:
+        invariants.check_state(self._max_epoch > 0, "no topology yet")
+        return self._epochs[self._max_epoch].global_topology
+
+    def current_local(self) -> Topology:
+        return self.current().for_node(self.node_id)
+
+    def for_epoch(self, epoch: int) -> Topology:
+        state = self._epochs.get(epoch)
+        invariants.check_state(state is not None, "unknown epoch %d", epoch)
+        return state.global_topology
+
+    def is_sync_complete(self, epoch: int) -> bool:
+        state = self._epochs.get(epoch)
+        return state is not None and state.sync_complete
+
+    def await_epoch(self, epoch: int) -> AsyncResult:
+        """Resolves (with the Topology) once `epoch` is known locally."""
+        if epoch in self._epochs:
+            return success(self._epochs[epoch].global_topology)
+        pending = self._pending.get(epoch)
+        if pending is None:
+            pending = self._pending[epoch] = AsyncResult()
+            if self._fetch_hook is not None:
+                self._fetch_hook(epoch)
+        return pending
+
+    # -- coordination epoch-window selection --
+    def precise_epochs(self, select, min_epoch: int, max_epoch: int) -> Topologies:
+        """Sub-topologies for exactly [min_epoch, max_epoch]
+        (TopologyManager.preciseEpochs)."""
+        out: List[Topology] = []
+        for e in range(max_epoch, min_epoch - 1, -1):
+            out.append(self.for_epoch(e).for_selection(select))
+        return Topologies(out)
+
+    def with_unsynced_epochs(self, select, min_epoch: int, max_epoch: int
+                             ) -> Topologies:
+        """[min_epoch, max_epoch] extended downward through epochs whose sync
+        has not yet quorum-completed, so replicas still serving old epochs are
+        contacted (TopologyManager.withUnsyncedEpochs)."""
+        lo = min_epoch
+        while lo > self._min_epoch and not self.is_sync_complete(lo):
+            lo -= 1
+        out: List[Topology] = []
+        for e in range(max_epoch, lo - 1, -1):
+            out.append(self.for_epoch(e).for_selection(select))
+        return Topologies(out)
+
+    def with_open_epochs(self, select, min_epoch: int, max_epoch: int) -> Topologies:
+        return self.with_unsynced_epochs(select, min_epoch, max_epoch)

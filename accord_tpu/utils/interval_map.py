@@ -59,10 +59,6 @@ class ReducingIntervalMap(Generic[V]):
                 values.append(reduce_fn(old, value) if old is not None else value)
             else:
                 values.append(old)
-        # span starting before first original bound:
-        first = self.values[0]
-        if start < (self.bounds[0] if self.bounds else end) and start == points[0]:
-            pass  # handled by loop since start is a point
         return self._normalized(bounds, values)
 
     def merge(self, other: "ReducingIntervalMap[V]",

@@ -1,0 +1,291 @@
+"""Direct-transition tests of the local state machine: preaccept/accept/commit/
+apply and the WaitingOn execution ordering, on a single in-memory store.
+(Reference model: unit paths of Commands.java exercised by CommandTest-style
+tests.)"""
+
+import pytest
+
+from accord_tpu.api.spi import Agent, EventsListener, ProgressLog
+from accord_tpu.impl.list_store import (
+    ListQuery, ListRead, ListStore, ListUpdate,
+)
+from accord_tpu.local import commands as C
+from accord_tpu.local.command import Command
+from accord_tpu.local.status import Durability, SaveStatus
+from accord_tpu.local.store import CommandStore, PreLoadContext, SafeCommandStore
+from accord_tpu.primitives.deps import Deps, KeyDeps
+from accord_tpu.primitives.keys import Key, Keys, Ranges, Route, RoutingKeys
+from accord_tpu.primitives.timestamp import (
+    Ballot, Domain, Timestamp, TxnId, TxnKind,
+)
+from accord_tpu.primitives.txn import Txn
+
+
+class _Agent(Agent):
+    def __init__(self):
+        self.failures = []
+
+    def on_uncaught_exception(self, failure):
+        self.failures.append(failure)
+        raise failure
+
+    def empty_txn(self, kind, keys_or_ranges):
+        return Txn(kind, keys_or_ranges)
+
+
+class _NullProgressLog(ProgressLog):
+    pass
+
+
+class FakeNode:
+    """Just enough of Node for the store tier: HLC + SPI plumbing."""
+
+    def __init__(self, node_id=1, epoch=1):
+        self.id = node_id
+        self.epoch = epoch
+        self.agent = _Agent()
+        self.data_store = ListStore(node_id)
+        self.events = EventsListener()
+        self._progress_log = _NullProgressLog()
+        self._hlc = 0
+
+    def progress_log_for(self, store):
+        return self._progress_log
+
+    def unique_now(self):
+        self._hlc += 1
+        return Timestamp(self.epoch, self._hlc, 0, self.id)
+
+    def unique_now_at_least(self, at_least):
+        self._hlc = max(self._hlc, at_least.hlc) + 1
+        return Timestamp(max(self.epoch, at_least.epoch), self._hlc, 0, self.id)
+
+
+@pytest.fixture
+def env():
+    node = FakeNode()
+    store = CommandStore(0, node, Ranges.of((0, 1000)))
+    safe = SafeCommandStore(store, PreLoadContext.empty())
+    return node, store, safe
+
+
+def write_txn(node, tokens, value, hlc=None):
+    keys = Keys.of(*tokens)
+    txn = Txn(TxnKind.WRITE, keys, read=ListRead(keys), query=ListQuery(),
+              update=ListUpdate({Key(t): value for t in tokens}))
+    if hlc is None:
+        ts = node.unique_now()
+    else:
+        ts = Timestamp(node.epoch, hlc, 0, node.id)
+    txn_id = TxnId.create(ts.epoch, ts.hlc, TxnKind.WRITE, Domain.KEY, ts.node)
+    route = Route.of_keys(keys[0].as_routing(), keys.as_routing())
+    return txn_id, txn, route
+
+
+def full_commit(safe, txn_id, txn, route, deps=None, execute_at=None):
+    deps = deps if deps is not None else Deps.NONE
+    execute_at = execute_at or txn_id
+    partial = txn.slice(Ranges.of((0, 1000)), include_query=True)
+    return C.commit(safe, txn_id, route, partial, execute_at, deps, stable=True)
+
+
+class TestPreAccept:
+    def test_fast_path_vote_when_no_conflict(self, env):
+        node, store, safe = env
+        txn_id, txn, route = write_txn(node, [10], 1)
+        partial = txn.slice(Ranges.of((0, 1000)), include_query=True)
+        outcome, witnessed = C.preaccept(safe, txn_id, partial, route)
+        assert outcome == C.AcceptOutcome.SUCCESS
+        assert witnessed == txn_id  # no conflicts -> fast-path vote
+        assert safe.get(txn_id).save_status == SaveStatus.PRE_ACCEPTED
+
+    def test_conflict_proposes_later_timestamp(self, env):
+        node, store, safe = env
+        t1, txn1, route1 = write_txn(node, [10], 1)
+        C.preaccept(safe, t1, txn1.slice(Ranges.of((0, 1000)), True), route1)
+        # lower txn_id arriving after a higher conflicting one -> slow path
+        t0 = TxnId.create(1, 0, TxnKind.WRITE, Domain.KEY, 9)
+        txn0_keys = Keys.of(10)
+        txn0 = Txn(TxnKind.WRITE, txn0_keys, update=ListUpdate({Key(10): 5}),
+                   query=ListQuery())
+        route0 = Route.of_keys(Key(10).as_routing(), txn0_keys.as_routing())
+        outcome, witnessed = C.preaccept(
+            safe, t0, txn0.slice(Ranges.of((0, 1000)), True), route0)
+        assert outcome == C.AcceptOutcome.SUCCESS
+        assert witnessed > t1  # pushed past the conflict
+
+    def test_redundant_preaccept(self, env):
+        node, store, safe = env
+        txn_id, txn, route = write_txn(node, [10], 1)
+        partial = txn.slice(Ranges.of((0, 1000)), True)
+        C.preaccept(safe, txn_id, partial, route)
+        outcome, witnessed = C.preaccept(safe, txn_id, partial, route)
+        assert outcome == C.AcceptOutcome.REDUNDANT
+        assert witnessed == txn_id
+
+    def test_deps_calculation(self, env):
+        node, store, safe = env
+        t1, txn1, route1 = write_txn(node, [10, 20], 1)
+        C.preaccept(safe, t1, txn1.slice(Ranges.of((0, 1000)), True), route1)
+        t2, txn2, route2 = write_txn(node, [20, 30], 2)
+        C.preaccept(safe, t2, txn2.slice(Ranges.of((0, 1000)), True), route2)
+        deps = C.calculate_deps(safe, t2, txn2.keys, t2)
+        assert deps.contains(t1)
+        assert deps.key_deps.txn_ids_for_key(Key(20)) == [t1]
+        assert deps.key_deps.txn_ids_for_key(Key(30)) == []
+        # t1 started first; it must not depend on t2
+        deps1 = C.calculate_deps(safe, t1, txn1.keys, t1)
+        assert not deps1.contains(t2)
+
+
+class TestBallots:
+    def test_accept_rejects_stale_ballot(self, env):
+        node, store, safe = env
+        txn_id, txn, route = write_txn(node, [10], 1)
+        C.preaccept(safe, txn_id, txn.slice(Ranges.of((0, 1000)), True), route)
+        b2 = Ballot(1, 50, 0, 2)
+        cmd = safe.get(txn_id)
+        cmd.set_promised(b2)
+        b1 = Ballot(1, 40, 0, 1)
+        outcome = C.accept(safe, txn_id, b1, route, txn.keys, txn_id, Deps.NONE)
+        assert outcome == C.AcceptOutcome.REJECTED_BALLOT
+        outcome2 = C.accept(safe, txn_id, b2, route, txn.keys, txn_id, Deps.NONE)
+        assert outcome2 == C.AcceptOutcome.SUCCESS
+        assert cmd.save_status == SaveStatus.ACCEPTED
+
+
+class TestCommitAndExecute:
+    def test_commit_stable_no_deps_executes(self, env):
+        node, store, safe = env
+        txn_id, txn, route = write_txn(node, [10], 7)
+        C.preaccept(safe, txn_id, txn.slice(Ranges.of((0, 1000)), True), route)
+        assert full_commit(safe, txn_id, txn, route) == C.AcceptOutcome.SUCCESS
+        cmd = safe.get(txn_id)
+        assert cmd.save_status == SaveStatus.READY_TO_EXECUTE
+        # apply with writes
+        writes = txn.execute(txn_id, txn_id, None)
+        out = C.apply(safe, txn_id, route, txn_id, Deps.NONE, writes, None)
+        assert out == C.ApplyOutcome.SUCCESS
+        assert cmd.save_status == SaveStatus.APPLIED
+        assert node.data_store.get(Key(10)) == (7,)
+
+    def test_execution_waits_for_deps_in_executeat_order(self, env):
+        node, store, safe = env
+        t1, txn1, route1 = write_txn(node, [10], 1)
+        t2, txn2, route2 = write_txn(node, [10], 2)
+        C.preaccept(safe, t1, txn1.slice(Ranges.of((0, 1000)), True), route1)
+        C.preaccept(safe, t2, txn2.slice(Ranges.of((0, 1000)), True), route2)
+        deps2 = Deps(KeyDeps.of({Key(10): {t1}}), None)
+        # commit t2 (depends on t1) first: must wait
+        full_commit(safe, t2, txn2, route2, deps=deps2)
+        cmd2 = safe.get(t2)
+        assert cmd2.save_status == SaveStatus.STABLE
+        assert cmd2.waiting_on.is_waiting_on(t1)
+        writes2 = txn2.execute(t2, t2, None)
+        C.apply(safe, t2, route2, t2, deps2, writes2, None)
+        assert safe.get(t2).save_status == SaveStatus.PRE_APPLIED  # still blocked
+        # now commit+apply t1 -> unblocks t2
+        full_commit(safe, t1, txn1, route1)
+        writes1 = txn1.execute(t1, t1, None)
+        C.apply(safe, t1, route1, t1, Deps.NONE, writes1, None)
+        assert safe.get(t1).save_status == SaveStatus.APPLIED
+        assert safe.get(t2).save_status == SaveStatus.APPLIED
+        # writes landed in executeAt order
+        assert node.data_store.get(Key(10)) == (1, 2)
+
+    def test_dep_committed_after_us_does_not_block(self, env):
+        node, store, safe = env
+        t1, txn1, route1 = write_txn(node, [10], 1)
+        t2, txn2, route2 = write_txn(node, [10], 2)
+        C.preaccept(safe, t1, txn1.slice(Ranges.of((0, 1000)), True), route1)
+        C.preaccept(safe, t2, txn2.slice(Ranges.of((0, 1000)), True), route2)
+        # t1 slow-pathed to execute AFTER t2 (executeAt > t2's)
+        late = Timestamp(1, 100, 0, 1)
+        deps2 = Deps(KeyDeps.of({Key(10): {t1}}), None)
+        full_commit(safe, t2, txn2, route2, deps=deps2)
+        cmd2 = safe.get(t2)
+        assert cmd2.waiting_on.is_waiting_on(t1)
+        # committing t1 with late executeAt releases t2
+        full_commit(safe, t1, txn1, route1,
+                    deps=Deps(KeyDeps.of({Key(10): {t2}}), None),
+                    execute_at=late)
+        assert not cmd2.waiting_on.is_waiting_on(t1)
+        assert cmd2.save_status == SaveStatus.READY_TO_EXECUTE
+
+    def test_invalidated_dep_unblocks(self, env):
+        node, store, safe = env
+        t1, txn1, route1 = write_txn(node, [10], 1)
+        t2, txn2, route2 = write_txn(node, [10], 2)
+        C.preaccept(safe, t1, txn1.slice(Ranges.of((0, 1000)), True), route1)
+        deps2 = Deps(KeyDeps.of({Key(10): {t1}}), None)
+        full_commit(safe, t2, txn2, route2, deps=deps2)
+        cmd2 = safe.get(t2)
+        assert cmd2.waiting_on.is_waiting_on(t1)
+        C.commit_invalidate(safe, t1)
+        assert safe.get(t1).save_status == SaveStatus.INVALIDATED
+        assert cmd2.save_status == SaveStatus.READY_TO_EXECUTE
+
+    def test_apply_before_commit_is_sufficient_with_deps(self, env):
+        node, store, safe = env
+        txn_id, txn, route = write_txn(node, [10], 9)
+        writes = txn.execute(txn_id, txn_id, None)
+        partial = txn.slice(Ranges.of((0, 1000)), True)
+        out = C.apply(safe, txn_id, route, txn_id, Deps.NONE, writes, None,
+                      partial_txn=partial)
+        assert out == C.ApplyOutcome.SUCCESS
+        assert safe.get(txn_id).save_status == SaveStatus.APPLIED
+        assert node.data_store.get(Key(10)) == (9,)
+
+    def test_apply_without_deps_insufficient(self, env):
+        node, store, safe = env
+        txn_id, txn, route = write_txn(node, [10], 9)
+        writes = txn.execute(txn_id, txn_id, None)
+        out = C.apply(safe, txn_id, route, txn_id, None, writes, None)
+        assert out == C.ApplyOutcome.INSUFFICIENT
+
+
+class TestChains:
+    def test_long_apply_chain_no_recursion_blowup(self, env):
+        node, store, safe = env
+        n = 3000  # deep pure chain: far beyond the python recursion limit
+        ids = []
+        txns = []
+        routes = []
+        for i in range(n):
+            t, txn, route = write_txn(node, [10], i)
+            ids.append(t); txns.append(txn); routes.append(route)
+            C.preaccept(safe, t, txn.slice(Ranges.of((0, 1000)), True), route)
+        # commit+preapply all in reverse order; each depends on its predecessor
+        # only, so applying t0 last cascades the full chain in one wave
+        for i in reversed(range(n)):
+            deps = Deps(KeyDeps.of({Key(10): {ids[i - 1]}}), None) if i else Deps.NONE
+            full_commit(safe, ids[i], txns[i], routes[i], deps=deps)
+            writes = txns[i].execute(ids[i], ids[i], None)
+            C.apply(safe, ids[i], routes[i], ids[i], deps, writes, None)
+        # whole chain should have cascaded to APPLIED
+        assert all(safe.get(t).save_status == SaveStatus.APPLIED for t in ids)
+        assert node.data_store.get(Key(10)) == tuple(range(n))
+
+
+class TestDurabilityAndTruncation:
+    def test_set_durability_and_purge(self, env):
+        node, store, safe = env
+        txn_id, txn, route = write_txn(node, [10], 1)
+        C.preaccept(safe, txn_id, txn.slice(Ranges.of((0, 1000)), True), route)
+        full_commit(safe, txn_id, txn, route)
+        writes = txn.execute(txn_id, txn_id, None)
+        C.apply(safe, txn_id, route, txn_id, Deps.NONE, writes, None)
+        C.set_durability(safe, txn_id, Durability.MAJORITY)
+        cmd = safe.get(txn_id)
+        assert cmd.durability == Durability.MAJORITY
+        C.purge(safe, txn_id)
+        assert cmd.save_status == SaveStatus.TRUNCATED_APPLY
+        assert cmd.partial_txn is None and cmd.writes is None
+
+    def test_purge_not_applied_rejected(self, env):
+        node, store, safe = env
+        txn_id, txn, route = write_txn(node, [10], 1)
+        C.preaccept(safe, txn_id, txn.slice(Ranges.of((0, 1000)), True), route)
+        from accord_tpu.utils.invariants import InvariantError
+        with pytest.raises(InvariantError):
+            C.purge(safe, txn_id)

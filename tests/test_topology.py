@@ -1,0 +1,203 @@
+"""Topology-layer tests (reference models: ShardTest quorum arithmetic,
+TopologyManagerTest, tracking tests)."""
+
+import pytest
+
+from accord_tpu.primitives.keys import Key, Keys, Range, Ranges, RoutingKey, RoutingKeys
+from accord_tpu.topology.shard import (
+    Shard, fast_path_quorum_size, max_tolerated_failures, slow_path_quorum_size,
+)
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.topology.topology import Topology
+from accord_tpu.topology.manager import TopologyManager
+from accord_tpu.coordinate.tracking import (
+    FastPathTracker, QuorumTracker, ReadTracker, RecoveryTracker, RequestStatus,
+)
+from accord_tpu.utils.invariants import InvariantError
+
+
+def topo(epoch=1, nodes=(1, 2, 3), nshards=2, span=100):
+    width = span // nshards
+    shards = [Shard(Range(i * width, (i + 1) * width), list(nodes))
+              for i in range(nshards)]
+    return Topology(epoch, shards)
+
+
+class TestShardQuorums:
+    def test_quorum_arithmetic_matches_reference(self):
+        # (rf, e) -> (maxFailures, slowQ, fastQ) per Shard.java:55-91
+        cases = {
+            (3, 3): (1, 2, 3),
+            (3, 2): (1, 2, 2),
+            (4, 4): (1, 3, 3),
+            (5, 5): (2, 3, 4),
+            (5, 3): (2, 3, 3),
+            (7, 7): (3, 4, 6),
+            (9, 9): (4, 5, 7),
+        }
+        for (rf, e), (f, slow, fast) in cases.items():
+            assert max_tolerated_failures(rf) == f, (rf, e)
+            assert slow_path_quorum_size(rf) == slow, (rf, e)
+            assert fast_path_quorum_size(rf, e, f) == fast, (rf, e)
+
+    def test_electorate_must_cover_rf_minus_f(self):
+        with pytest.raises(InvariantError):
+            fast_path_quorum_size(5, 2, 2)  # e=2 < rf-f=3
+
+    def test_rejects_fast_path(self):
+        s = Shard(Range(0, 10), [1, 2, 3, 4, 5])  # e=5, fastQ=4
+        assert not s.rejects_fast_path(1)
+        assert s.rejects_fast_path(2)  # 2 > 5 - 4
+
+    def test_recovery_fast_path_size(self):
+        assert Shard(Range(0, 1), [1, 2, 3]).recovery_fast_path_size == 1
+        assert Shard(Range(0, 1), [1, 2, 3, 4, 5]).recovery_fast_path_size == 1
+        assert Shard(Range(0, 1), list(range(1, 8))).recovery_fast_path_size == 2
+
+
+class TestTopology:
+    def test_selection_and_routing(self):
+        t = topo(nshards=4)  # shards [0,25) [25,50) [50,75) [75,100)
+        assert t.shard_for_token(10).range == Range(0, 25)
+        assert t.shard_for_token(99).range == Range(75, 100)
+        assert t.shard_for_token(100) is None
+        sel = t.shards_for(Keys.of(10, 60))
+        assert [s.range for s in sel] == [Range(0, 25), Range(50, 75)]
+        sel2 = t.shards_for(Ranges.of((20, 55)))
+        assert [s.range for s in sel2] == [Range(0, 25), Range(25, 50), Range(50, 75)]
+
+    def test_per_node_subsets(self):
+        shards = [Shard(Range(0, 50), [1, 2]), Shard(Range(50, 100), [2, 3])]
+        t = Topology(1, shards)
+        assert t.nodes() == {1, 2, 3}
+        assert t.ranges_for_node(1) == Ranges.of((0, 50))
+        assert t.ranges_for_node(2) == Ranges.of((0, 100))
+        assert t.for_node(3).size == 1
+
+    def test_overlapping_shards_rejected(self):
+        with pytest.raises(InvariantError):
+            Topology(1, [Shard(Range(0, 50), [1]), Shard(Range(40, 90), [1])])
+
+
+class TestTopologies:
+    def test_window(self):
+        ts = Topologies([topo(epoch=2), topo(epoch=3), topo(epoch=1)])
+        assert ts.current_epoch == 3 and ts.oldest_epoch == 1
+        assert ts.for_epoch(2).epoch == 2
+        assert ts.get(0).epoch == 3  # newest first
+        with pytest.raises(InvariantError):
+            Topologies([topo(epoch=1), topo(epoch=3)])  # gap
+
+    def test_node_union(self):
+        a = Topology(1, [Shard(Range(0, 50), [1, 2])])
+        b = Topology(2, [Shard(Range(0, 50), [2, 3])])
+        assert Topologies([a, b]).nodes() == {1, 2, 3}
+
+
+class TestTrackers:
+    def test_quorum_tracker(self):
+        qt = QuorumTracker(Topologies.single(topo(nodes=(1, 2, 3))))
+        assert qt.record_success(1) == RequestStatus.NO_CHANGE
+        assert qt.record_success(2) == RequestStatus.SUCCESS
+
+    def test_quorum_tracker_failure(self):
+        qt = QuorumTracker(Topologies.single(topo(nodes=(1, 2, 3))))
+        assert qt.record_failure(1) == RequestStatus.NO_CHANGE
+        assert qt.record_failure(2) == RequestStatus.FAILED
+
+    def test_multi_epoch_quorum_needs_both(self):
+        old = Topology(1, [Shard(Range(0, 100), [1, 2, 3])])
+        new = Topology(2, [Shard(Range(0, 100), [3, 4, 5])])
+        qt = QuorumTracker(Topologies([old, new]))
+        qt.record_success(1)
+        assert qt.record_success(2) == RequestStatus.NO_CHANGE  # epoch2 not quorate
+        qt.record_success(4)
+        assert qt.record_success(5) == RequestStatus.SUCCESS
+
+    def test_fast_path_tracker(self):
+        ft = FastPathTracker(Topologies.single(topo(nodes=(1, 2, 3), nshards=1)))
+        ft.record_success(1, with_fast_path_accept=True)
+        st = ft.record_success(2, with_fast_path_accept=True)
+        assert st == RequestStatus.SUCCESS  # slow quorum reached
+        assert not ft.has_fast_path_accepted  # fastQ = 3 for rf=3,e=3
+        ft.record_success(3, with_fast_path_accept=True)
+        assert ft.has_fast_path_accepted
+
+    def test_fast_path_rejection(self):
+        ft = FastPathTracker(Topologies.single(topo(nodes=(1, 2, 3), nshards=1)))
+        ft.record_success(1, with_fast_path_accept=False)
+        assert ft.has_rejected_fast_path  # 1 > 3 - 3
+
+    def test_read_tracker_retry(self):
+        rt = ReadTracker(Topologies.single(topo(nodes=(1, 2, 3), nshards=1)))
+        contacts = rt.initial_contacts()
+        assert len(contacts) == 1
+        n = contacts[0]
+        status, retry = rt.record_read_failure(n)
+        assert status == RequestStatus.NO_CHANGE and len(retry) == 1
+        assert rt.record_read_success(retry[0]) == RequestStatus.SUCCESS
+
+    def test_read_tracker_exhaustion(self):
+        rt = ReadTracker(Topologies.single(topo(nodes=(1, 2), nshards=1)))
+        (n,) = rt.initial_contacts()
+        status, retry = rt.record_read_failure(n)
+        assert status == RequestStatus.NO_CHANGE
+        status, retry = rt.record_read_failure(retry[0])
+        assert status == RequestStatus.FAILED and not retry
+
+    def test_recovery_tracker_vote_math(self):
+        rt = RecoveryTracker(Topologies.single(topo(nodes=(1, 2, 3), nshards=1)))
+        rt.record_success(1, rejects_fast_path=False)
+        assert not rt.rejects_fast_path()
+        st = rt.record_success(2, rejects_fast_path=True)
+        assert st == RequestStatus.SUCCESS
+        assert rt.rejects_fast_path()  # 1 reject > e(3) - fastQ(3) = 0
+
+
+class TestTopologyManager:
+    def test_epoch_ledger_and_sync(self):
+        tm = TopologyManager(node_id=1)
+        t1 = topo(epoch=1)
+        tm.on_topology_update(t1)
+        assert tm.epoch == 1
+        assert tm.is_sync_complete(1)  # first epoch auto-syncs
+        t2 = topo(epoch=2)
+        tm.on_topology_update(t2)
+        assert not tm.is_sync_complete(2)
+        tm.on_epoch_sync_complete(1, 2)
+        assert not tm.is_sync_complete(2)
+        tm.on_epoch_sync_complete(2, 2)
+        assert tm.is_sync_complete(2)  # quorum 2/3 in both shards
+
+    def test_await_epoch(self):
+        tm = TopologyManager(node_id=1)
+        fetched = []
+        tm.set_fetch_hook(fetched.append)
+        tm.on_topology_update(topo(epoch=1))
+        fut = tm.await_epoch(2)
+        assert not fut.is_done and fetched == [2]
+        tm.on_topology_update(topo(epoch=2))
+        assert fut.is_done and fut.value().epoch == 2
+
+    def test_epoch_window_selection(self):
+        tm = TopologyManager(node_id=1)
+        tm.on_topology_update(topo(epoch=1))
+        tm.on_topology_update(topo(epoch=2))
+        tm.on_topology_update(topo(epoch=3))
+        sel = Keys.of(10)
+        # epoch 2,3 unsynced -> window extends to 1
+        w = tm.with_unsynced_epochs(sel, 3, 3)
+        assert (w.oldest_epoch, w.current_epoch) == (1, 3)
+        for n in (1, 2, 3):
+            tm.on_epoch_sync_complete(n, 2)
+            tm.on_epoch_sync_complete(n, 3)
+        w2 = tm.with_unsynced_epochs(sel, 3, 3)
+        assert (w2.oldest_epoch, w2.current_epoch) == (3, 3)
+        p = tm.precise_epochs(sel, 1, 2)
+        assert (p.oldest_epoch, p.current_epoch) == (1, 2)
+
+    def test_out_of_order_epoch_rejected(self):
+        tm = TopologyManager(node_id=1)
+        tm.on_topology_update(topo(epoch=1))
+        with pytest.raises(InvariantError):
+            tm.on_topology_update(topo(epoch=3))
