@@ -1,0 +1,5 @@
+"""Coordination state machines (reference: accord/coordinate — SURVEY.md §2.5)."""
+
+from accord_tpu.coordinate.errors import (
+    CoordinationFailed, Timeout, Preempted, Invalidated, Truncated, Exhausted,
+)

@@ -49,10 +49,6 @@ class ShardTracker:
         """Quorum is unreachable: too many of this shard's replicas failed."""
         return len(self.failures) > self.shard.max_failures
 
-    @property
-    def has_in_flight(self) -> bool:
-        return len(self.successes) + len(self.failures) < self.shard.rf
-
 
 class AbstractTracker:
     """Folds ShardTrackers over every epoch in the Topologies window."""
@@ -134,6 +130,19 @@ class FastPathShardTracker(ShardTracker):
     def has_rejected_fast_path(self) -> bool:
         return self.shard.rejects_fast_path(len(self.fast_path_rejects))
 
+    @property
+    def has_decided_fast_path(self) -> bool:
+        """Fast path accepted, or no longer achievable even if every
+        outstanding electorate member votes accept (the PreAccept round must
+        not complete before this is stable — FastPathTracker.java)."""
+        if self.has_fast_path_accepted:
+            return True
+        outstanding = (len(self.shard.fast_path_electorate)
+                       - len(self.fast_path_accepts)
+                       - len(self.fast_path_rejects))
+        return (len(self.fast_path_accepts) + outstanding
+                < self.shard.fast_path_quorum_size)
+
 
 class FastPathTracker(AbstractTracker):
     """PreAccept tracker: slow-path quorum overall + per-shard electorate
@@ -155,6 +164,21 @@ class FastPathTracker(AbstractTracker):
                 t.on_fast_path_reject(n)
         return self._apply(node, fn)
 
+    def record_failure(self, node: int) -> RequestStatus:
+        def fn(t: FastPathShardTracker, n: int):
+            t.on_failure(n)
+            # a dead electorate member can never vote accept
+            t.on_fast_path_reject(n)
+        return self._apply(node, fn)
+
+    def _status(self) -> RequestStatus:
+        if any(t.has_failed for t in self.trackers):
+            return RequestStatus.FAILED
+        if all(t.has_reached_quorum and t.has_decided_fast_path
+               for t in self.trackers):
+            return RequestStatus.SUCCESS
+        return RequestStatus.NO_CHANGE
+
     @property
     def has_fast_path_accepted(self) -> bool:
         return all(t.has_fast_path_accepted for t in self.trackers)
@@ -175,12 +199,6 @@ class ReadShardTracker(ShardTracker):
     @property
     def has_data(self) -> bool:
         return self.data_success
-
-    @property
-    def has_failed_read(self) -> bool:
-        """No outstanding read and no data: every candidate exhausted."""
-        return (not self.data_success and not self.in_flight_reads
-                and len(self.failures) >= self.shard.rf)
 
 
 class ReadTracker(AbstractTracker):

@@ -106,9 +106,9 @@ class CommandsForKey:
             i = find_ceil(self._ids, txn_id)
             self._ids.insert(i, txn_id)
         else:
-            # per-key status only advances (monotone view of the command)
-            if status < info.status and not (
-                    status == InternalStatus.INVALID_OR_TRUNCATED):
+            # per-key status only advances (monotone view of the command;
+            # INVALID_OR_TRUNCATED is the maximum so it always applies)
+            if status < info.status:
                 return
             info.status = status
             if execute_at is not None:

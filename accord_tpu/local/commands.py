@@ -62,14 +62,25 @@ def calculate_deps(safe_store: SafeCommandStore, txn_id: TxnId, keys: Keys,
 
 
 def propose_execute_at(safe_store: SafeCommandStore, txn_id: TxnId,
-                       participants) -> Timestamp:
-    """executeAt proposal: txn_id itself when no conflict is newer (fast-path
-    vote), else a fresh HLC strictly after every known conflict
-    (Commands.preaccept executeAt selection via MaxConflicts/TimestampsForKey)."""
+                       participants, permit_fast_path: bool) -> Timestamp:
+    """executeAt proposal (CommandStore.preaccept :320-345): txn_id itself when
+    no conflict is newer AND the fast path is permitted (ballot zero — recovery
+    must not mint fast-path votes — and txn_id's epoch is current), else a
+    fresh HLC strictly after every known conflict."""
+    node = safe_store.node
+    # preaccept expiry: stale-clocked coordinators get a REJECTED proposal the
+    # coordinator turns into invalidation (CommandStore.preaccept isExpired)
+    if not txn_id.kind.is_sync_point:
+        elapsed_us = node.now_us() - txn_id.hlc
+        if elapsed_us >= safe_store.agent.pre_accept_timeout() * 1e6:
+            return node.unique_now_at_least(txn_id).as_rejected()
     max_conflict = safe_store.max_conflict(participants)
-    if max_conflict is None or max_conflict < txn_id:
+    if (max_conflict is None or max_conflict < txn_id) and permit_fast_path \
+            and txn_id.epoch >= node.epoch:
         return txn_id
-    return safe_store.node.unique_now_at_least(max_conflict)
+    floor = max_conflict if max_conflict is not None and max_conflict > txn_id \
+        else txn_id
+    return node.unique_now_at_least(floor)
 
 
 # ---------------------------------------------------------------- preaccept --
@@ -85,18 +96,24 @@ def preaccept(safe_store: SafeCommandStore, txn_id: TxnId,
     if not cmd.may_accept(ballot):
         return AcceptOutcome.REJECTED_BALLOT, None
     if cmd.has_been(SaveStatus.PRE_ACCEPTED):
-        # replay/recovery: return the previously witnessed timestamp
+        # competing recovery (ballot>0) still records its promise; a zero
+        # ballot here is a replay (Commands.preacceptOrRecover :160-168)
+        cmd.set_promised(ballot)
         return AcceptOutcome.REDUNDANT, cmd.execute_at_or_txn_id()
 
     cmd.update_route(route)
+    cmd.set_promised(ballot)
     if partial_txn is not None:
         cmd.partial_txn = partial_txn
     participants = (partial_txn.keys if partial_txn is not None
                     else route.participants())
-    witnessed_at = propose_execute_at(safe_store, txn_id, participants)
+    witnessed_at = propose_execute_at(safe_store, txn_id, participants,
+                                      permit_fast_path=ballot == Ballot.ZERO)
     cmd.execute_at = witnessed_at
     cmd.set_status(SaveStatus.PRE_ACCEPTED)
-    safe_store.update_max_conflicts(participants, txn_id)
+    # fence later proposals with the witnessed executeAt, not the txn id
+    # (CommandStore.updateMaxConflicts :280-289 records executeAt)
+    safe_store.update_max_conflicts(participants, witnessed_at)
     safe_store.register(cmd, InternalStatus.PREACCEPTED)
     if txn_id.is_range_domain and partial_txn is not None:
         safe_store.register_range_txn(cmd, partial_txn.keys)

@@ -1,0 +1,289 @@
+"""Node: the per-process runtime (reference: accord/local/Node.java:100-780).
+
+Wires MessageSink / ConfigurationService / TopologyManager / CommandStores /
+Agent / Scheduler; owns the HLC (uniqueNow, Node.java:341-366), txn-id
+allocation (:562), coordination entry (:567-596), routing helpers (:598-673),
+message receive + epoch gating (:715-736), and send helpers with
+store-affine callbacks (:431-533).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from accord_tpu.api.spi import (
+    Agent, EventsListener, LocalConfig, MessageSink, ProgressLog, Scheduler,
+)
+from accord_tpu.coordinate.errors import Timeout
+from accord_tpu.local.store import CommandStores, PreLoadContext
+from accord_tpu.messages.base import Callback, FailureReply, Reply, Request, TxnRequest
+from accord_tpu.primitives.keys import Keys, Ranges, Route, RoutingKey
+from accord_tpu.primitives.timestamp import Domain, Timestamp, TxnId, TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.topology.manager import TopologyManager
+from accord_tpu.topology.topology import Topology
+from accord_tpu.utils import invariants
+from accord_tpu.utils.async_chains import AsyncResult, success
+from accord_tpu.utils.random_source import RandomSource
+
+
+class _SafeCallback:
+    """Once-only callback wrapper with timeout arming (reference
+    SafeCallback + Node timeout registration)."""
+
+    def __init__(self, node: "Node", to: int, callback: Callback):
+        self.node = node
+        self.to = to
+        self.callback = callback
+        self.done = False
+        self.timer = None
+
+    def arm_timeout(self, delay_s: float) -> None:
+        self.timer = self.node.scheduler.once(delay_s, self._on_timeout)
+
+    def _on_timeout(self) -> None:
+        if not self.done:
+            self.done = True
+            try:
+                self.callback.on_failure(self.to, Timeout())
+            except BaseException as e:  # noqa: BLE001
+                self.callback.on_callback_failure(self.to, e)
+
+    def deliver(self, reply) -> None:
+        if self.done:
+            return
+        self.done = True
+        if self.timer is not None:
+            self.timer.cancel()
+        try:
+            if isinstance(reply, FailureReply):
+                self.callback.on_failure(self.to, reply.failure)
+            elif isinstance(reply, BaseException):
+                self.callback.on_failure(self.to, reply)
+            else:
+                self.callback.on_success(self.to, reply)
+        except BaseException as e:  # noqa: BLE001
+            self.callback.on_callback_failure(self.to, e)
+
+
+class Node:
+    def __init__(self, node_id: int, sink: MessageSink, agent: Agent,
+                 scheduler: Scheduler, data_store, random: RandomSource,
+                 num_shards: int = 1, config: LocalConfig = None,
+                 progress_log_factory: Callable = None,
+                 store_factory: Callable = None,
+                 now_us: Callable[[], int] = None,
+                 events: EventsListener = None):
+        self.id = node_id
+        self.sink = sink
+        self.agent = agent
+        self.scheduler = scheduler
+        self.data_store = data_store
+        self.random = random
+        self.config = config or LocalConfig.default()
+        self.topology = TopologyManager(node_id)
+        self.command_stores = CommandStores(self, num_shards,
+                                            store_factory=store_factory)
+        self.events = events or EventsListener()
+        self._progress_log_factory = progress_log_factory
+        self._progress_logs: Dict[int, ProgressLog] = {}
+        self._now_us = now_us or (lambda: 0)
+        self._hlc = 0
+        self.coordinating: Dict[TxnId, AsyncResult] = {}
+        self._reply_seq = 0
+
+    # ------------------------------------------------------------ lifecycle --
+    def on_topology_update(self, topology: Topology, start_sync: bool = True
+                           ) -> Ranges:
+        """Feed a new epoch (reference Node.onTopologyUpdate :247-255).
+        Returns ranges newly owned by this node (bootstrap targets)."""
+        self.topology.on_topology_update(topology)
+        owned = topology.ranges_for_node(self.id)
+        added = self.command_stores.update_topology(owned)
+        return added
+
+    def progress_log_for(self, store) -> ProgressLog:
+        pl = self._progress_logs.get(store.id)
+        if pl is None:
+            if self._progress_log_factory is None:
+                pl = ProgressLog.__new__(_NullProgressLog)
+            else:
+                pl = self._progress_log_factory(self, store)
+            self._progress_logs[store.id] = pl
+        return pl
+
+    # ------------------------------------------------------------------ HLC --
+    def now_us(self) -> int:
+        """Wall (or virtual) clock in microseconds."""
+        return self._now_us()
+
+    def unique_now(self) -> Timestamp:
+        """Monotonic unique HLC (Node.uniqueNow CAS loop, :341-366)."""
+        self._hlc = max(self._hlc + 1, self._now_us())
+        return Timestamp(self.epoch, self._hlc, 0, self.id)
+
+    def unique_now_at_least(self, at_least: Timestamp) -> Timestamp:
+        self._hlc = max(self._hlc + 1, self._now_us(), at_least.hlc + 1)
+        epoch = max(self.epoch, at_least.epoch)
+        return Timestamp(epoch, self._hlc, 0, self.id)
+
+    def on_remote_timestamp(self, ts: Timestamp) -> None:
+        """Merge a remote HLC observation (epoch/hlc propagation)."""
+        if ts.hlc > self._hlc:
+            self._hlc = ts.hlc
+
+    @property
+    def epoch(self) -> int:
+        return max(1, self.topology.epoch)
+
+    def next_txn_id(self, kind: TxnKind, domain: Domain) -> TxnId:
+        now = self.unique_now()
+        return TxnId.create(now.epoch, now.hlc, kind, domain, self.id)
+
+    # -------------------------------------------------------------- routing --
+    def compute_route(self, txn: Txn) -> Route:
+        """Home-key selection (Node.java:598-617): a routing key from the
+        txn's participants, preferring one this node owns."""
+        if isinstance(txn.keys, Keys):
+            routing = txn.keys.as_routing()
+            invariants.check_argument(len(routing) > 0, "txn has no keys")
+            home = self._select_home_key(list(routing))
+            return Route.of_keys(home, routing)
+        ranges = txn.keys
+        invariants.check_argument(len(ranges) > 0, "txn has no ranges")
+        home = self._select_home_key(
+            [RoutingKey(r.start) for r in ranges])
+        return Route.of_ranges(home, ranges)
+
+    def _select_home_key(self, candidates: List[RoutingKey]) -> RoutingKey:
+        local = self.topology.current().ranges_for_node(self.id)
+        for k in candidates:
+            if local.contains(k):
+                return k
+        return candidates[0]
+
+    # --------------------------------------------------------- coordination --
+    def coordinate(self, txn: Txn, txn_id: Optional[TxnId] = None
+                   ) -> AsyncResult:
+        """Client entry: coordinate a transaction to its Result
+        (Node.coordinate :567-596)."""
+        from accord_tpu.coordinate.transaction import CoordinateTransaction
+        domain = Domain.KEY if isinstance(txn.keys, Keys) else Domain.RANGE
+        if txn_id is None:
+            txn_id = self.next_txn_id(txn.kind, domain)
+        result = AsyncResult()
+        self.coordinating[txn_id] = result
+        result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
+        self.with_epoch(txn_id.epoch,
+                        lambda: CoordinateTransaction(self, txn_id, txn,
+                                                      result).start())
+        return result
+
+    def recover(self, txn_id: TxnId, route: Route) -> AsyncResult:
+        """Recovery entry (Node.recover :685)."""
+        from accord_tpu.coordinate.recover import Recover
+        existing = self.coordinating.get(txn_id)
+        if existing is not None:
+            return existing
+        result = AsyncResult()
+        self.coordinating[txn_id] = result
+        result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
+        self.with_epoch(txn_id.epoch,
+                        lambda: Recover(self, txn_id, route, result).start())
+        return result
+
+    def with_epoch(self, epoch: int, fn: Callable[[], None]) -> None:
+        """Run fn once `epoch` is locally known (Node.withEpoch)."""
+        if self.topology.has_epoch(epoch):
+            fn()
+        else:
+            self.topology.await_epoch(epoch).add_callback(
+                lambda v, f: fn() if f is None else self.agent
+                .on_uncaught_exception(f))
+
+    # ------------------------------------------------------------ messaging --
+    def send(self, to_nodes, request: Request,
+             callback: Optional[Callback] = None,
+             timeout_s: Optional[float] = None) -> None:
+        """Send to one or many nodes, optionally registering a reply callback
+        with timeout (Node.send helpers :431-533)."""
+        if isinstance(to_nodes, int):
+            to_nodes = [to_nodes]
+        for to in to_nodes:
+            if callback is not None:
+                safe = _SafeCallback(self, to, callback)
+                safe.arm_timeout(timeout_s if timeout_s is not None
+                                 else self.agent.pre_accept_timeout() * 10)
+                self.sink.send_with_callback(to, request, safe)
+            else:
+                self.sink.send(to, request)
+
+    def reply(self, to: int, reply_context, reply: Reply) -> None:
+        self.sink.reply(to, reply_context, reply)
+
+    def receive(self, request: Request, from_id: int, reply_context) -> None:
+        """Inbound dispatch with epoch gating (Node.receive :715-736)."""
+        wait_for = request.wait_for_epoch
+        if wait_for and not self.topology.has_epoch(wait_for):
+            self.topology.await_epoch(wait_for).add_callback(
+                lambda v, f: self._process(request, from_id, reply_context))
+            return
+        self._process(request, from_id, reply_context)
+
+    def _process(self, request: Request, from_id: int, reply_context) -> None:
+        try:
+            request.process(self, from_id, reply_context)
+        except BaseException as e:  # noqa: BLE001
+            if reply_context is not None:
+                self.reply(from_id, reply_context, FailureReply(e))
+            else:
+                self.agent.on_uncaught_exception(e)
+
+    def local_request(self, request: Request) -> None:
+        """Apply a local-only request (PROPAGATE_*) to our own stores."""
+        request.process(self, self.id, None)
+
+    # ------------------------------------------------- store fan-out/reduce --
+    def map_reduce_consume_local(self, request: TxnRequest, from_id: int,
+                                 reply_context) -> None:
+        """Fan a TxnRequest out over intersecting command stores, reduce the
+        replies (async-aware), reply to the sender
+        (Node.mapReduceConsumeLocal :405 -> CommandStores.mapReduceConsume)."""
+        participants = request.participants()
+        context = PreLoadContext.for_txn(request.txn_id)
+        stores = self.command_stores.intersecting(participants)
+        if not stores:
+            if reply_context is not None:
+                self.reply(from_id, reply_context,
+                           FailureReply(RuntimeError("no intersecting store")))
+            return
+        pending: List[AsyncResult] = []
+        for s in stores:
+            raw = s.submit(context, request.apply)
+            pending.append(_flatten(raw))
+        from accord_tpu.utils import async_chains
+
+        def finish(values, failure):
+            if reply_context is None:
+                if failure is not None:
+                    self.agent.on_uncaught_exception(failure)
+                return
+            if failure is not None:
+                self.reply(from_id, reply_context, FailureReply(failure))
+                return
+            acc = values[0]
+            for v in values[1:]:
+                acc = request.reduce(acc, v)
+            self.reply(from_id, reply_context, acc)
+
+        async_chains.all_of(pending).add_callback(finish)
+
+
+def _flatten(result: AsyncResult) -> AsyncResult:
+    """Requests may return a Reply or an AsyncResult[Reply]; flatten."""
+    return result.flat_map(
+        lambda v: v if isinstance(v, AsyncResult) else success(v))
+
+
+class _NullProgressLog(ProgressLog):
+    pass

@@ -135,20 +135,18 @@ class SaveStatus(enum.IntEnum):
 
 
 class Durability(enum.IntEnum):
-    """Global durability classification (reference Status.Durability)."""
+    """Global durability classification (reference Status.Durability:
+    NotDurable / Local / ShardUniversal / MajorityOrInvalidated /
+    UniversalOrInvalidated — the top two absorb invalidation)."""
 
     NOT_DURABLE = 0
     LOCAL = 1                    # applied locally
     SHARD_UNIVERSAL = 2          # applied at every live replica of home shard
-    MAJORITY = 3                 # applied at a majority of every shard
-    UNIVERSAL = 4                # applied at every replica of every shard
+    MAJORITY = 3                 # applied at a majority of every shard (or invalidated)
+    UNIVERSAL = 4                # applied at every replica of every shard (or invalidated)
 
     @property
     def is_durable(self) -> bool:
-        return self >= Durability.MAJORITY
-
-    @property
-    def is_durable_or_invalidated(self) -> bool:
         return self >= Durability.MAJORITY
 
 

@@ -142,24 +142,16 @@ class RedundantBefore:
 
         pre = post = False
         if isinstance(participants, _SortedKeyList):
-            for k in participants:
-                if probe(self._entry_for_key(k)):
-                    pre = True
-                else:
-                    post = True
+            probes = [probe(self._entry_for_key(k)) for k in participants]
         else:
             # evaluate every map span intersecting each range, so a fence
             # covering only part of the span is seen
+            probes = []
             for r in participants:
-                for s, e_, v in self._map.spans():
-                    inter = not ((e_ is not None and e_ <= r.start)
-                                 or (s is not None and s >= r.end))
-                    if not inter:
-                        continue
-                    if probe(v):
-                        pre = True
-                    else:
-                        post = True
+                self._map.fold_intersecting(
+                    r.start, r.end, lambda acc, v: probes.append(probe(v)), None)
+        pre = any(probes)
+        post = not all(probes) or not probes
         if pre and not post:
             return PreBootstrapOrStale.FULLY
         if pre:
@@ -167,22 +159,15 @@ class RedundantBefore:
         return PreBootstrapOrStale.POST_BOOTSTRAP
 
     def min_locally_applied_before(self, ranges: Ranges) -> TxnId:
-        """Floor watermark across `ranges` (for GC gating)."""
+        """Floor watermark across `ranges`: any uncovered span floors the
+        result to NONE (for GC gating)."""
+        def fold(acc, v):
+            w = v.locally_applied_before if v is not None else TXNID_NONE
+            return w if acc is None else min(acc, w)
+
         result: Optional[TxnId] = None
         for r in ranges:
-            def fold(acc, s, e_, v):
-                return v.locally_applied_before if acc is None \
-                    else min(acc, v.locally_applied_before)
-            covered = self._map.fold(fold, None, r.start, r.end)
-            # any uncovered span means watermark is NONE
-            for s, e_, v in self._map.spans():
-                inter = not ((e_ is not None and e_ <= r.start)
-                             or (s is not None and s >= r.end))
-                if inter and v is None:
-                    return TXNID_NONE
-            if covered is None:
-                return TXNID_NONE
-            result = covered if result is None else min(result, covered)
+            result = self._map.fold_intersecting(r.start, r.end, fold, result)
         return result if result is not None else TXNID_NONE
 
 

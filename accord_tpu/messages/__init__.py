@@ -1,0 +1,10 @@
+"""Wire protocol (reference: accord/messages — SURVEY.md §2.4)."""
+
+from accord_tpu.messages.base import (
+    MessageType, Request, Reply, TxnRequest, Callback, SimpleReply, FailureReply,
+)
+from accord_tpu.messages.preaccept import PreAccept, PreAcceptOk, PreAcceptNack
+from accord_tpu.messages.accept import Accept, AcceptOk, AcceptNack
+from accord_tpu.messages.commit import Commit, CommitInvalidate
+from accord_tpu.messages.apply_msg import Apply, ApplyReply
+from accord_tpu.messages.read import ReadTxnData, ReadOk, ReadNack

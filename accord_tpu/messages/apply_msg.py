@@ -1,0 +1,78 @@
+"""Apply: deliver the outcome (writes + result) for asynchronous persistence.
+
+Reference: accord/messages/Apply.java:47 — Kinds Minimal/Maximal (:72);
+Commands.apply then reply Applied/Redundant/Insufficient (:146-210).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from accord_tpu.local import commands as C
+from accord_tpu.messages.base import MessageType, Reply, TxnRequest
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import Timestamp, TxnId
+from accord_tpu.primitives.txn import PartialTxn
+from accord_tpu.primitives.writes import Writes
+
+
+class ApplyReply(Reply):
+    type = MessageType.APPLY_RSP
+
+    APPLIED = "Applied"
+    REDUNDANT = "Redundant"
+    INSUFFICIENT = "Insufficient"
+
+    def __init__(self, outcome: str):
+        self.outcome = outcome
+
+    def __eq__(self, other):
+        return isinstance(other, ApplyReply) and self.outcome == other.outcome
+
+    def __repr__(self):
+        return f"ApplyReply({self.outcome})"
+
+
+class ApplyKind(enum.Enum):
+    MINIMAL = MessageType.APPLY_MINIMAL_REQ
+    MAXIMAL = MessageType.APPLY_MAXIMAL_REQ
+
+
+class Apply(TxnRequest):
+    def __init__(self, kind: ApplyKind, txn_id: TxnId, scope: Route,
+                 execute_at: Timestamp, deps: Optional[Deps],
+                 writes: Optional[Writes], result,
+                 partial_txn: Optional[PartialTxn] = None):
+        super().__init__(txn_id, scope, wait_for_epoch=execute_at.epoch)
+        self.kind = kind
+        self.type = kind.value
+        self.execute_at = execute_at
+        self.deps = deps
+        self.writes = writes
+        self.result = result
+        self.partial_txn = partial_txn  # Maximal only
+
+    def apply(self, safe_store):
+        deps = self.deps
+        if deps is not None and not safe_store.ranges.is_empty:
+            deps = deps.slice(safe_store.ranges)
+        writes = self.writes
+        if writes is not None and not safe_store.ranges.is_empty:
+            writes = writes.slice(safe_store.ranges)
+        outcome = C.apply(safe_store, self.txn_id, self.scope, self.execute_at,
+                          deps, writes, self.result,
+                          partial_txn=self.partial_txn)
+        return ApplyReply({
+            C.ApplyOutcome.SUCCESS: ApplyReply.APPLIED,
+            C.ApplyOutcome.REDUNDANT: ApplyReply.REDUNDANT,
+            C.ApplyOutcome.INSUFFICIENT: ApplyReply.INSUFFICIENT,
+        }[outcome])
+
+    def reduce(self, a: ApplyReply, b: ApplyReply) -> ApplyReply:
+        order = [ApplyReply.INSUFFICIENT, ApplyReply.APPLIED, ApplyReply.REDUNDANT]
+        return a if order.index(a.outcome) <= order.index(b.outcome) else b
+
+    def __repr__(self):
+        return f"Apply({self.kind.name}, {self.txn_id!r}@{self.execute_at!r})"

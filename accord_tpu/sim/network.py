@@ -1,0 +1,150 @@
+"""Simulated network: per-link nemesis actions and randomized delays.
+
+Reference: accord-core test impl/basic/NodeSink.java:45 (Action {DELIVER,
+DROP, DELIVER_WITH_FAILURE, FAILURE}), Cluster.java:518+ (partition
+generator / LinkConfig). All deliveries are Pending items in the shared
+virtual-time queue, so message interleavings derive entirely from the seed.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, Optional, Tuple
+
+from accord_tpu.api.spi import MessageSink
+from accord_tpu.messages.base import FailureReply, Reply, Request
+from accord_tpu.sim.queue import PendingQueue
+from accord_tpu.utils.random_source import RandomSource
+
+
+class Action(enum.Enum):
+    DELIVER = "DELIVER"
+    DROP = "DROP"
+    DELIVER_WITH_FAILURE = "DELIVER_WITH_FAILURE"  # deliver, but fail the response path
+    FAILURE = "FAILURE"                            # fail without delivering
+
+
+class LinkConfig:
+    """Per-ordered-pair link behavior."""
+
+    def __init__(self, deliver_prob: float = 1.0, min_delay_us: int = 500,
+                 max_delay_us: int = 20_000, down: bool = False):
+        self.deliver_prob = deliver_prob
+        self.min_delay_us = min_delay_us
+        self.max_delay_us = max_delay_us
+        self.down = down
+
+    def action(self, random: RandomSource) -> Action:
+        if self.down:
+            return Action.DROP
+        if random.next_float() < self.deliver_prob:
+            return Action.DELIVER
+        return Action.DROP
+
+
+class SimNetwork:
+    def __init__(self, queue: PendingQueue, random: RandomSource):
+        self.queue = queue
+        self.random = random
+        self.nodes: Dict[int, object] = {}          # node_id -> Node
+        self.links: Dict[Tuple[int, int], LinkConfig] = {}
+        self.default_link = LinkConfig()
+        self.stats: Dict[str, int] = {}
+        self.on_deliver: Optional[Callable] = None  # tracing hook
+
+    def register(self, node) -> None:
+        self.nodes[node.id] = node
+
+    def link(self, from_id: int, to_id: int) -> LinkConfig:
+        return self.links.get((from_id, to_id), self.default_link)
+
+    def set_link(self, from_id: int, to_id: int, config: LinkConfig) -> None:
+        self.links[(from_id, to_id)] = config
+
+    def partition(self, group_a, group_b) -> None:
+        """Sever links between two node groups (both directions)."""
+        for a in group_a:
+            for b in group_b:
+                self.set_link(a, b, LinkConfig(down=True))
+                self.set_link(b, a, LinkConfig(down=True))
+
+    def heal(self) -> None:
+        self.links.clear()
+
+    def _count(self, what: str) -> None:
+        self.stats[what] = self.stats.get(what, 0) + 1
+
+    def deliver_request(self, from_id: int, to_id: int, request: Request,
+                        reply_context) -> None:
+        link = self.link(from_id, to_id)
+        action = link.action(self.random)
+        msg_name = type(request).__name__
+        if action == Action.DROP:
+            self._count(f"drop.{msg_name}")
+            return
+        self._count(f"deliver.{msg_name}")
+        delay = (link.min_delay_us
+                 if link.max_delay_us <= link.min_delay_us
+                 else self.random.next_int(link.min_delay_us, link.max_delay_us))
+
+        def run():
+            node = self.nodes.get(to_id)
+            if node is None:
+                return
+            if self.on_deliver is not None:
+                self.on_deliver(from_id, to_id, request)
+            node.receive(request, from_id, reply_context)
+
+        self.queue.add(delay, run)
+
+    def deliver_reply(self, from_id: int, to_id: int, msg_id: int,
+                      reply: Reply) -> None:
+        link = self.link(from_id, to_id)
+        if link.action(self.random) == Action.DROP:
+            self._count(f"drop.{type(reply).__name__}")
+            return
+        self._count(f"deliver.{type(reply).__name__}")
+        delay = (link.min_delay_us
+                 if link.max_delay_us <= link.min_delay_us
+                 else self.random.next_int(link.min_delay_us, link.max_delay_us))
+
+        def run():
+            node = self.nodes.get(to_id)
+            if node is None:
+                return
+            sink: NodeSink = node.sink
+            sink.deliver_reply(msg_id, from_id, reply)
+
+        self.queue.add(delay, run)
+
+
+class NodeSink(MessageSink):
+    """MessageSink bound to one simulated node."""
+
+    def __init__(self, node_id: int, network: SimNetwork):
+        self.node_id = node_id
+        self.network = network
+        self._seq = 0
+        self._callbacks: Dict[int, object] = {}  # msg_id -> _SafeCallback
+
+    def send(self, to: int, request: Request) -> None:
+        self.network.deliver_request(self.node_id, to, request, None)
+
+    def send_with_callback(self, to: int, request: Request, callback,
+                           executor=None) -> None:
+        self._seq += 1
+        msg_id = self._seq
+        self._callbacks[msg_id] = callback
+        self.network.deliver_request(self.node_id, to, request,
+                                     (self.node_id, msg_id))
+
+    def reply(self, to: int, reply_context, reply: Reply) -> None:
+        if reply_context is None:
+            return
+        origin, msg_id = reply_context
+        self.network.deliver_reply(self.node_id, origin, msg_id, reply)
+
+    def deliver_reply(self, msg_id: int, from_id: int, reply: Reply) -> None:
+        callback = self._callbacks.pop(msg_id, None)
+        if callback is not None:
+            callback.deliver(reply)

@@ -91,6 +91,15 @@ class ReducingIntervalMap(Generic[V]):
             acc = fn(acc, s, e, v)
         return acc
 
+    def fold_intersecting(self, start, end, fn: Callable, acc):
+        """foldl fn(acc, value_or_None) over every span (including
+        no-information None spans) intersecting [start, end)."""
+        for s, e, v in self.spans():
+            if (e is not None and e <= start) or (s is not None and s >= end):
+                continue
+            acc = fn(acc, v)
+        return acc
+
     def spans(self) -> List[Tuple]:
         """[(start|None, end|None, value)] covering the whole line."""
         out: List[Tuple] = []

@@ -1,0 +1,144 @@
+"""End-to-end: full simulated cluster committing transactions through the real
+PreAccept/Accept/Stable+Read/Apply message path (reference model:
+CoordinateTransactionTest on MockCluster)."""
+
+import pytest
+
+from accord_tpu.impl.list_store import ListQuery, ListRead, ListResult, ListUpdate
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.primitives.keys import Key, Keys
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.sim.network import LinkConfig
+
+
+def rw_txn(read_tokens, appends: dict):
+    keys = Keys.of(*(set(read_tokens) | set(appends)))
+    return Txn(TxnKind.WRITE if appends else TxnKind.READ, keys,
+               read=ListRead(Keys.of(*read_tokens)) if read_tokens else None,
+               query=ListQuery(),
+               update=ListUpdate({Key(t): v for t, v in appends.items()})
+               if appends else None)
+
+
+def run_txn(cluster, node_id, txn):
+    result = cluster.node(node_id).coordinate(txn)
+    ok = cluster.process_until(lambda: result.is_done)
+    assert ok, "txn did not complete"
+    return result.value()
+
+
+class TestSingleTxn:
+    def test_write_then_read(self):
+        cluster = SimCluster(n_nodes=3, seed=1)
+        r1 = run_txn(cluster, 1, rw_txn([], {10: 42}))
+        assert isinstance(r1, ListResult)
+        assert r1.appends == {Key(10): 42}
+        r2 = run_txn(cluster, 2, rw_txn([10], {}))
+        assert r2.read_values[Key(10)] == (42,)
+
+    def test_multi_key_cross_shard(self):
+        cluster = SimCluster(n_nodes=3, seed=2, n_shards=4)
+        run_txn(cluster, 1, rw_txn([], {10: 1, 600: 2}))  # two different shards
+        r = run_txn(cluster, 3, rw_txn([10, 600], {}))
+        assert r.read_values[Key(10)] == (1,)
+        assert r.read_values[Key(600)] == (2,)
+
+    def test_read_your_writes_rmw(self):
+        cluster = SimCluster(n_nodes=3, seed=3)
+        for v in range(5):
+            run_txn(cluster, 1 + v % 3, rw_txn([7], {7: v}))
+        r = run_txn(cluster, 1, rw_txn([7], {}))
+        assert r.read_values[Key(7)] == (0, 1, 2, 3, 4)
+
+    def test_all_replicas_converge(self):
+        cluster = SimCluster(n_nodes=3, seed=4)
+        for v in range(3):
+            run_txn(cluster, 1, rw_txn([], {5: v}))
+        cluster.process_all()  # let Apply reach everyone
+        for node in cluster.nodes.values():
+            assert node.data_store.get(Key(5)) == (0, 1, 2)
+
+    def test_fast_path_taken_when_uncontended(self):
+        events = []
+
+        cluster = SimCluster(n_nodes=3, seed=5)
+        for node in cluster.nodes.values():
+            node.events.on_fast_path_taken = \
+                lambda txn_id, deps=None: events.append(("fast", txn_id))
+            node.events.on_slow_path_taken = \
+                lambda txn_id, deps=None: events.append(("slow", txn_id))
+        run_txn(cluster, 1, rw_txn([], {10: 1}))
+        assert events and all(kind == "fast" for kind, _ in events)
+
+
+class TestConcurrency:
+    def test_concurrent_conflicting_writes_all_commit(self):
+        cluster = SimCluster(n_nodes=3, seed=6)
+        results = [cluster.node(1 + i % 3).coordinate(rw_txn([], {9: i}))
+                   for i in range(6)]
+        assert cluster.process_until(lambda: all(r.is_done for r in results))
+        for r in results:
+            r.value()  # no failures
+        cluster.process_all()
+        # all replicas converge on one order containing all six values
+        histories = {n: cluster.node(n).data_store.get(Key(9))
+                     for n in cluster.nodes}
+        vals = set(histories[1])
+        assert vals == set(range(6))
+        assert histories[1] == histories[2] == histories[3]
+
+    def test_concurrent_rmw_strict_serializable_reads(self):
+        cluster = SimCluster(n_nodes=3, seed=7)
+        results = [cluster.node(1 + i % 3).coordinate(rw_txn([11], {11: i}))
+                   for i in range(4)]
+        assert cluster.process_until(lambda: all(r.is_done for r in results))
+        reads = [r.value().read_values[Key(11)] for r in results]
+        cluster.process_all()
+        final = cluster.node(1).data_store.get(Key(11))
+        assert set(final) == set(range(4))
+        # each read must be a strict prefix of the final order (reads see
+        # exactly the writes ordered before them)
+        for read in reads:
+            assert final[:len(read)] == read
+
+    def test_cross_shard_atomicity(self):
+        # writes to two shards in one txn must be visible atomically
+        cluster = SimCluster(n_nodes=3, seed=8, n_shards=2)
+        for i in range(4):
+            run_txn(cluster, 1 + i % 3, rw_txn([], {100: i, 900: i}))
+        r = run_txn(cluster, 2, rw_txn([100, 900], {}))
+        assert r.read_values[Key(100)] == r.read_values[Key(900)]
+
+
+class TestFaults:
+    def test_commit_with_one_node_down(self):
+        cluster = SimCluster(n_nodes=3, seed=9)
+        cluster.network.partition([3], [1, 2])
+        r = run_txn(cluster, 1, rw_txn([], {10: 7}))
+        assert r.appends == {Key(10): 7}
+        # read quorum still works
+        r2 = run_txn(cluster, 2, rw_txn([10], {}))
+        assert r2.read_values[Key(10)] == (7,)
+
+    def test_lossy_network_still_commits(self):
+        cluster = SimCluster(n_nodes=3, seed=10)
+        cluster.network.default_link = LinkConfig(deliver_prob=0.85)
+        # with retries-by-timeout not yet implemented, individual txns may
+        # time out; commit enough and require a clear majority to succeed
+        ok = 0
+        for i in range(10):
+            result = cluster.node(1 + i % 3).coordinate(rw_txn([], {4: i}))
+            cluster.process_until(lambda: result.is_done)
+            if result.is_done and result.is_success:
+                ok += 1
+        assert ok >= 5
+
+    def test_minority_partition_cannot_commit(self):
+        cluster = SimCluster(n_nodes=5, seed=11, rf=5)
+        cluster.network.partition([1], [2, 3, 4, 5])
+        result = cluster.node(1).coordinate(rw_txn([], {10: 1}))
+        cluster.process_until(lambda: result.is_done)
+        assert result.is_done
+        assert not result.is_success  # timed out / exhausted

@@ -52,6 +52,9 @@ class FakeNode:
     def progress_log_for(self, store):
         return self._progress_log
 
+    def now_us(self):
+        return self._hlc
+
     def unique_now(self):
         self._hlc += 1
         return Timestamp(self.epoch, self._hlc, 0, self.id)

@@ -118,10 +118,22 @@ class TestTrackers:
         ft = FastPathTracker(Topologies.single(topo(nodes=(1, 2, 3), nshards=1)))
         ft.record_success(1, with_fast_path_accept=True)
         st = ft.record_success(2, with_fast_path_accept=True)
-        assert st == RequestStatus.SUCCESS  # slow quorum reached
-        assert not ft.has_fast_path_accepted  # fastQ = 3 for rf=3,e=3
-        ft.record_success(3, with_fast_path_accept=True)
+        # slow quorum reached but fast path (fastQ=3) still undecided: the
+        # round must keep waiting (FastPathTracker.java semantics)
+        assert st == RequestStatus.NO_CHANGE
+        assert not ft.has_fast_path_accepted
+        st = ft.record_success(3, with_fast_path_accept=True)
+        assert st == RequestStatus.SUCCESS
         assert ft.has_fast_path_accepted
+
+    def test_fast_path_tracker_failure_decides(self):
+        ft = FastPathTracker(Topologies.single(topo(nodes=(1, 2, 3), nshards=1)))
+        ft.record_success(1, with_fast_path_accept=True)
+        ft.record_success(2, with_fast_path_accept=True)
+        # node 3 dead: fast path impossible -> round completes via failure
+        assert ft.record_failure(3) == RequestStatus.SUCCESS
+        assert not ft.has_fast_path_accepted
+        assert ft.has_rejected_fast_path
 
     def test_fast_path_rejection(self):
         ft = FastPathTracker(Topologies.single(topo(nodes=(1, 2, 3), nshards=1)))
