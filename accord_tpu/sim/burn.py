@@ -1,0 +1,183 @@
+"""The burn test: randomized full-cluster simulation with verification.
+
+Reference: accord-core test burn/BurnTest.java:316-553 + impl/basic/Cluster
+(SURVEY.md §4a): a seeded workload of multi-key reads/writes/RMWs driven
+through a simulated cluster; every response feeds the strict-serializability
+verifier; acks/nacks/timeouts are tallied and asserted non-pathological;
+everything derives from one seed (`--loop-seed` reproduction).
+
+Usage:  python -m accord_tpu.sim.burn -s SEED -o OPS [--nodes N] [--drop P]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from accord_tpu.impl.list_store import ListQuery, ListRead, ListResult, ListUpdate
+from accord_tpu.primitives.keys import Key, Keys
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.sim.network import LinkConfig
+from accord_tpu.sim.verify import Observation, StrictSerializabilityVerifier
+from accord_tpu.utils.random_source import RandomSource
+
+
+class BurnStats:
+    def __init__(self):
+        self.acks = 0
+        self.nacks = 0
+        self.lost = 0
+        self.pending = 0
+
+    def __repr__(self):
+        return (f"acks={self.acks} nacks={self.nacks} lost={self.lost} "
+                f"pending={self.pending}")
+
+
+class BurnRun:
+    def __init__(self, seed: int, ops: int, nodes: int = 3, keys: int = 20,
+                 drop_prob: float = 0.0, rf: int = None, n_shards: int = 4,
+                 concurrency: int = 8,
+                 progress_log_factory=None, num_command_stores: int = 1):
+        self.seed = seed
+        self.ops = ops
+        self.rng = RandomSource(seed)
+        self.cluster = SimCluster(
+            n_nodes=nodes, seed=self.rng.next_long(), n_shards=n_shards,
+            rf=rf, progress_log_factory=progress_log_factory,
+            num_command_stores=num_command_stores)
+        if drop_prob > 0:
+            self.cluster.network.default_link = LinkConfig(
+                deliver_prob=1.0 - drop_prob)
+        self.keys = keys
+        self.concurrency = concurrency
+        self.verifier = StrictSerializabilityVerifier()
+        self.stats = BurnStats()
+        self.next_value = 0
+        self._value_owner: Dict[int, dict] = {}
+
+    # ---------------------------------------------------------- workload --
+    def _gen_txn(self) -> Txn:
+        rng = self.rng
+        n_read = rng.next_int(0, 3)
+        n_write = rng.next_int(0, 3) if n_read else rng.next_int(1, 3)
+        read_tokens = {rng.next_zipf(self.keys) for _ in range(n_read)}
+        write_tokens = {rng.next_zipf(self.keys) for _ in range(n_write)}
+        appends = {}
+        for t in write_tokens:
+            appends[t] = self.next_value
+            self.next_value += 1
+        all_tokens = read_tokens | write_tokens
+        # RMWs read what they write (the strongest check)
+        read_set = read_tokens | (write_tokens if rng.next_bool() else set())
+        return Txn(
+            TxnKind.WRITE if appends else TxnKind.READ,
+            Keys.of(*all_tokens),
+            read=ListRead(Keys.of(*read_set)) if read_set else None,
+            query=ListQuery(),
+            update=ListUpdate({Key(t): v for t, v in appends.items()})
+            if appends else None)
+
+    # --------------------------------------------------------------- run --
+    def run(self) -> BurnStats:
+        cluster = self.cluster
+        submitted = [0]
+        inflight = [0]
+        observations = []
+
+        def submit_one():
+            if submitted[0] >= self.ops:
+                return
+            submitted[0] += 1
+            idx = submitted[0]
+            inflight[0] += 1
+            txn = self._gen_txn()
+            origin = self.rng.pick(sorted(cluster.nodes))
+            start_us = cluster.queue.clock.now_us
+            result = cluster.node(origin).coordinate(txn)
+
+            def done(value, failure):
+                inflight[0] -= 1
+                end_us = cluster.queue.clock.now_us
+                if failure is not None:
+                    self.stats.nacks += 1
+                elif isinstance(value, ListResult):
+                    self.stats.acks += 1
+                    observations.append(Observation(
+                        f"txn{idx}@n{origin}",
+                        {k.token: v for k, v in value.read_values.items()},
+                        {k.token: v for k, v in value.appends.items()},
+                        start_us, end_us))
+                else:
+                    self.stats.lost += 1
+                # pipeline: keep `concurrency` txns in flight
+                submit_one()
+
+            result.add_callback(done)
+
+        for _ in range(min(self.concurrency, self.ops)):
+            submit_one()
+        cluster.process_all(max_items=50_000_000)
+        self.stats.pending = inflight[0]
+        tally = (self.stats.acks + self.stats.nacks + self.stats.lost
+                 + self.stats.pending)
+        assert tally == submitted[0], \
+            f"op accounting leak: {self.stats} vs submitted={submitted[0]}"
+
+        # final histories: majority agreement across replicas per key
+        final = self._final_histories()
+        for obs in observations:
+            self.verifier.observe(obs)
+        self.verifier.verify(final)
+        return self.stats
+
+    def _final_histories(self) -> Dict[int, Tuple[int, ...]]:
+        """Longest agreed history per key across replicas (replicas may lag
+        but must never diverge)."""
+        cluster = self.cluster
+        final: Dict[int, Tuple[int, ...]] = {}
+        all_tokens = set()
+        for node in cluster.nodes.values():
+            all_tokens.update(node.data_store.snapshot().keys())
+        for token in all_tokens:
+            histories = [node.data_store.get(Key(token))
+                         for node in cluster.nodes.values()]
+            longest = max(histories, key=len)
+            for h in histories:
+                if h != longest[:len(h)]:
+                    raise AssertionError(
+                        f"replica divergence on key {token}: {h} vs {longest}")
+            final[token] = longest
+        return final
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="accord-tpu burn test")
+    parser.add_argument("-s", "--seed", type=int, default=0)
+    parser.add_argument("-o", "--ops", type=int, default=200)
+    parser.add_argument("--nodes", type=int, default=3)
+    parser.add_argument("--keys", type=int, default=20)
+    parser.add_argument("--shards", type=int, default=4)
+    parser.add_argument("--drop", type=float, default=0.0)
+    parser.add_argument("--loops", type=int, default=1,
+                        help="run N consecutive seeds")
+    args = parser.parse_args(argv)
+    for i in range(args.loops):
+        seed = args.seed + i
+        run = BurnRun(seed, args.ops, nodes=args.nodes, keys=args.keys,
+                      n_shards=args.shards, drop_prob=args.drop)
+        stats = run.run()
+        print(f"seed={seed} ops={args.ops} {stats} "
+              f"virtual_time={run.cluster.now_s:.1f}s "
+              f"events={run.cluster.queue.processed} OK")
+        if stats.acks == 0:
+            print("PATHOLOGICAL: no transaction succeeded", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

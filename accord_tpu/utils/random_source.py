@@ -17,6 +17,8 @@ T = TypeVar("T")
 class RandomSource:
     """Deterministic PRNG with forking. Backed by Python's Mersenne twister."""
 
+    _zipf_cache: dict = {}  # shared cumulative-weight tables, keyed (n, alpha)
+
     def __init__(self, seed: int):
         self._seed = seed
         self._rng = _pyrandom.Random(seed)
@@ -63,13 +65,22 @@ class RandomSource:
         return self._rng.gauss(mu, sigma)
 
     def next_zipf(self, n: int, alpha: float = 0.99) -> int:
-        """Zipfian index in [0, n) via inverse-CDF rejection (test workloads)."""
-        # Rejection-inversion (Jain's approximation) — adequate for workloads.
-        while True:
-            u = self._rng.random()
-            x = int(n ** u)
-            if x < n and self._rng.random() < (1.0 / (x + 1)) ** alpha / (1.0 / 1.0) ** alpha:
-                return x
+        """Zipfian-distributed index in [0, n): exact inverse-CDF over rank
+        weights (k+1)^-alpha, cumulative table cached per (n, alpha)."""
+        if n <= 1:
+            return 0
+        import bisect
+        key = (n, alpha)
+        cum = self._zipf_cache.get(key)
+        if cum is None:
+            total = 0.0
+            cum = []
+            for k in range(1, n + 1):
+                total += k ** -alpha
+                cum.append(total)
+            self._zipf_cache[key] = cum
+        u = self._rng.random() * cum[-1]
+        return min(bisect.bisect_left(cum, u), n - 1)
 
     def biased_uniform(self, lo: int, hi: int, median: int) -> int:
         """Uniform with median skew (reference RandomSource.biasedUniformInts)."""
