@@ -1,0 +1,243 @@
+"""Propose / ExecutePath: the shared coordination tail.
+
+Reference: accord/coordinate/Propose.java (Accept round at a ballot),
+ExecuteTxn.java:53-140 (Stable+Read via Commit.stableAndRead), PersistTxn /
+CoordinationAdapter.persist (:188-206). Used by both CoordinateTransaction
+(ballot 0, Apply.Minimal) and Recover (ballot > 0, Apply.Maximal — the
+Step.InitiateRecovery adapter, CoordinationAdapter.java:196-206).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Timeout
+from accord_tpu.coordinate.tracking import QuorumTracker, ReadTracker, RequestStatus
+from accord_tpu.messages.accept import Accept, AcceptNack, AcceptOk
+from accord_tpu.messages.apply_msg import Apply, ApplyKind
+from accord_tpu.messages.base import Callback, TxnRequest
+from accord_tpu.messages.commit import Commit, CommitKind
+from accord_tpu.messages.read import ReadNack, ReadOk, ReadTxnData
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keys import Keys, Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.utils.async_chains import AsyncResult
+
+
+class Propose(Callback):
+    """Accept round at `ballot`; on quorum, hands the union of the freshly
+    calculated per-replica deps to `on_accepted` (Propose.java; the deps for
+    the commit round are the accept-round recalculations, Accept.java:84-130).
+    """
+
+    def __init__(self, node, txn_id: TxnId, txn: Txn, route: Route,
+                 ballot: Ballot, execute_at: Timestamp, deps: Deps,
+                 on_accepted, on_failed):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.ballot = ballot
+        self.execute_at = execute_at
+        self.deps = deps
+        self._on_accepted = on_accepted
+        self._on_failed = on_failed
+        self.oks: Dict[int, AcceptOk] = {}
+        self.tracker: Optional[QuorumTracker] = None
+        self.done = False
+
+    def start(self) -> None:
+        def ready():
+            topologies = self.node.topology.with_unsynced_epochs(
+                self.route.participants(), self.txn_id.epoch,
+                self.execute_at.epoch)
+            self.tracker = QuorumTracker(topologies)
+            for to in topologies.nodes():
+                scope = TxnRequest.compute_scope(to, topologies, self.route)
+                if scope is None:
+                    continue
+                keys = self.txn.keys.slice(scope.covering())
+                self.node.send(
+                    to, Accept(self.txn_id, self.ballot, scope, keys,
+                               self.execute_at, self.deps,
+                               max_epoch=self.execute_at.epoch,
+                               full_route=self.route),
+                    callback=self)
+
+        self.node.with_epoch(self.execute_at.epoch, ready)
+
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        if isinstance(reply, AcceptNack):
+            self.done = True
+            self._on_failed(Preempted(f"Accept nacked: {reply.reason.name}"))
+            return
+        self.oks[from_id] = reply
+        if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
+            self.done = True
+            self._on_accepted(Deps.merge([ok.deps for ok in self.oks.values()]))
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        if self.tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self.done = True
+            self._on_failed(failure if isinstance(failure, Timeout)
+                            else Exhausted(repr(failure)))
+
+
+class ExecutePath(Callback):
+    """Stable(+Read piggyback) round, then compute the outcome, unblock the
+    client, and send Apply (ExecuteTxn.java + PersistTxn)."""
+
+    def __init__(self, node, txn_id: TxnId, txn: Txn, route: Route,
+                 execute_at: Timestamp, deps: Deps, commit_kind: CommitKind,
+                 apply_kind: ApplyKind, result: AsyncResult):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.execute_at = execute_at
+        self.deps = deps
+        self.commit_kind = commit_kind
+        self.apply_kind = apply_kind
+        self.result = result
+        self.stable_tracker: Optional[QuorumTracker] = None
+        self.read_tracker: Optional[ReadTracker] = None
+        self.read_nodes: List[int] = []
+        self.read_data = None
+        self.executed = False
+        self.failed = False
+
+    def start(self) -> None:
+        self.node.with_epoch(self.execute_at.epoch, self._start)
+
+    def _start(self) -> None:
+        from accord_tpu.topology.topologies import Topologies
+        execute_epoch = self.execute_at.epoch
+        topologies = self.node.topology.with_unsynced_epochs(
+            self.route.participants(), self.txn_id.epoch, execute_epoch)
+        execute_topology = topologies.for_epoch(execute_epoch)
+        self.stable_tracker = QuorumTracker(topologies)
+        read_keys = (self.txn.read.keys() if self.txn.read is not None
+                     else Keys(()))
+        self.read_tracker = (ReadTracker(Topologies([execute_topology]))
+                             if read_keys else None)
+        prefer = [self.node.id] + sorted(execute_topology.nodes())
+        self.read_nodes = (self.read_tracker.initial_contacts(prefer)
+                           if self.read_tracker else [])
+        maximal = self.commit_kind == CommitKind.STABLE_MAXIMAL
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, self.route)
+            if scope is None:
+                continue
+            owned = scope.covering()
+            partial = self.txn.slice(owned, include_query=maximal)
+            to_read = (read_keys.slice(owned)
+                       if to in self.read_nodes else None)
+            self.node.send(
+                to, Commit(self.commit_kind, self.txn_id, scope, partial,
+                           self.execute_at, self.deps, read_keys=to_read,
+                           full_route=self.route),
+                callback=self)
+
+    # -- stable/read replies --
+    def on_success(self, from_id: int, reply) -> None:
+        if self.failed or self.executed:
+            return
+        if isinstance(reply, ReadNack):
+            if reply.reason == ReadNack.INVALID:
+                self._fail(Invalidated("invalidated during execution"))
+            elif reply.reason == ReadNack.REDUNDANT:
+                # the txn already has a decided outcome elsewhere (a competing
+                # coordinator/recovery persisted it): our read snapshot is
+                # gone and the txn needs no further driving. Settle without a
+                # locally computed result.
+                self._obsolete()
+            else:
+                self._retry_read(from_id)
+            return
+        if isinstance(reply, ReadOk):
+            if reply.data is not None:
+                self.read_data = (reply.data if self.read_data is None
+                                  else self.read_data.merge(reply.data))
+            if self.read_tracker is not None:
+                self.read_tracker.record_read_success(from_id)
+        self.stable_tracker.record_success(from_id)
+        self._maybe_finish()
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.failed or self.executed:
+            return
+        if self.stable_tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self._fail(failure if isinstance(failure, Timeout)
+                       else Exhausted(repr(failure)))
+            return
+        if from_id in self.read_nodes:
+            self._retry_read(from_id)
+
+    def _retry_read(self, from_id: int) -> None:
+        if self.read_tracker is None:
+            return
+        status, retry = self.read_tracker.record_read_failure(from_id)
+        if status == RequestStatus.FAILED:
+            self._fail(Exhausted("read candidates exhausted"))
+            return
+        read_keys = self.txn.read.keys()
+        topologies = self.node.topology.with_unsynced_epochs(
+            self.route.participants(), self.txn_id.epoch, self.execute_at.epoch)
+        for to in retry:
+            self.read_nodes.append(to)
+            scope = TxnRequest.compute_scope(to, topologies, self.route)
+            if scope is None:
+                continue
+            owned = scope.covering()
+            self.node.send(
+                to, ReadTxnData(self.txn_id, scope, read_keys.slice(owned),
+                                self.execute_at.epoch),
+                callback=self)
+
+    def _maybe_finish(self) -> None:
+        reads_done = (self.read_tracker is None
+                      or all(t.has_data for t in self.read_tracker.trackers))
+        if reads_done and self.stable_tracker.has_reached_quorum \
+                and not self.executed:
+            self.executed = True
+            self._persist()
+
+    def _persist(self) -> None:
+        writes = self.txn.execute(self.txn_id, self.execute_at, self.read_data)
+        result = (self.txn.result(self.txn_id, self.execute_at, self.read_data)
+                  if self.txn.query is not None else None)
+        maximal = self.apply_kind == ApplyKind.MAXIMAL
+        topologies = self.node.topology.with_unsynced_epochs(
+            self.route.participants(), self.txn_id.epoch, self.execute_at.epoch)
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, self.route)
+            if scope is None:
+                continue
+            partial = (self.txn.slice(scope.covering(), include_query=False)
+                       if maximal else None)
+            self.node.send(
+                to, Apply(self.apply_kind, self.txn_id, scope,
+                          self.execute_at, self.deps, writes, result,
+                          partial_txn=partial, full_route=self.route))
+        self.result.try_success(result)
+
+    def _obsolete(self) -> None:
+        """A competing coordinator persisted the outcome first; our read
+        snapshot is gone so we cannot compute the result. Report
+        unknown-outcome rather than claiming success without data (proper fix
+        is a CheckStatus fetch of the persisted outcome — future work)."""
+        self.executed = True
+        self.result.try_failure(Preempted(
+            f"{self.txn_id} outcome persisted by a competing coordinator; "
+            f"result not locally computable"))
+
+    def _fail(self, failure: BaseException) -> None:
+        self.failed = True
+        if isinstance(failure, Timeout):
+            self.node.events.on_timeout(self.txn_id)
+        self.result.try_failure(failure)

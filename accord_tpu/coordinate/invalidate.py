@@ -1,0 +1,79 @@
+"""Invalidation coordination.
+
+Reference: accord/coordinate/Invalidate.java (proposeInvalidate: ballot
+promise quorum in the single shard owning one participating key) and
+Commit.Invalidate.commitInvalidate (broadcast). Recovery uses this when it
+proves the transaction cannot have been decided (Recover.java:361-376).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from accord_tpu.coordinate.errors import Exhausted, Preempted, Timeout
+from accord_tpu.messages.accept import AcceptInvalidate, AcceptNack
+from accord_tpu.messages.base import Callback, TxnRequest
+from accord_tpu.messages.commit import CommitInvalidate
+from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import Ballot, TxnId
+
+
+class ProposeInvalidate(Callback):
+    """Promise `ballot` to invalidate at a quorum of the shard owning the
+    route's home key (Invalidate.proposeInvalidate)."""
+
+    def __init__(self, node, ballot: Ballot, txn_id: TxnId, route: Route,
+                 on_done, on_failed):
+        self.node = node
+        self.ballot = ballot
+        self.txn_id = txn_id
+        self.route = route
+        self._on_done = on_done
+        self._on_failed = on_failed
+        self.shard = None
+        self.promises = set()
+        self.failures = set()
+        self.done = False
+
+    def start(self) -> None:
+        from accord_tpu.primitives.keys import Ranges
+        topology = self.node.topology.for_epoch(self.txn_id.epoch)
+        self.shard = topology.shard_for_key(self.route.home_key)
+        scope = self.route.slice(Ranges([self.shard.range]))
+        for to in self.shard.nodes:
+            self.node.send(to, AcceptInvalidate(self.txn_id, self.ballot,
+                                                scope),
+                           callback=self)
+
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        if isinstance(reply, AcceptNack):
+            self.done = True
+            self._on_failed(Preempted(f"invalidate preempted: {reply.reason.name}"))
+            return
+        self.promises.add(from_id)
+        if len(self.promises) >= self.shard.slow_path_quorum_size:
+            self.done = True
+            self._on_done()
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        self.failures.add(from_id)
+        if len(self.failures) > self.shard.max_failures:
+            self.done = True
+            self._on_failed(failure if isinstance(failure, Timeout)
+                            else Exhausted(repr(failure)))
+
+
+def commit_invalidate(node, txn_id: TxnId, route: Route) -> None:
+    """Broadcast CommitInvalidate to every replica of the route
+    (Commit.Invalidate.commitInvalidate)."""
+    topologies = node.topology.with_unsynced_epochs(
+        route.participants(), txn_id.epoch, max(txn_id.epoch, node.epoch))
+    for to in topologies.nodes():
+        scope = TxnRequest.compute_scope(to, topologies, route)
+        if scope is None:
+            continue
+        node.send(to, CommitInvalidate(txn_id, scope))

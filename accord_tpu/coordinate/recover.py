@@ -1,0 +1,363 @@
+"""Recover: leaderless recovery of a (possibly abandoned) transaction.
+
+Reference: accord/coordinate/Recover.java:76-405 — quorum of BeginRecovery at
+a fresh ballot; if anything Accepted-or-later is found, complete it; otherwise
+decipher whether the fast path could have been taken (RecoveryTracker vote
+math + per-replica rejectsFastPath predicates), invalidating when provably
+not, completing at the original timestamp when it may have been. Earlier
+accepted-without-witness txns must commit before the decision is sound
+(awaitCommits -> retry). Recovered txns persist with Apply.Maximal
+(CoordinationAdapter Step.InitiateRecovery, CoordinationAdapter.java:196-206).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Timeout
+from accord_tpu.coordinate.execute import ExecutePath, Propose
+from accord_tpu.coordinate.invalidate import ProposeInvalidate, commit_invalidate
+from accord_tpu.coordinate.tracking import QuorumTracker, RecoveryTracker, RequestStatus
+from accord_tpu.messages.apply_msg import Apply, ApplyKind
+from accord_tpu.messages.base import Callback, TxnRequest
+from accord_tpu.messages.commit import CommitKind
+from accord_tpu.messages.getdeps import GetDeps, GetDepsOk
+from accord_tpu.messages.recover import BeginRecovery, RecoverNack, RecoverOk
+from accord_tpu.messages.wait import WaitOnCommit
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.utils import invariants
+from accord_tpu.utils.async_chains import AsyncResult
+
+
+class Recover(Callback):
+    def __init__(self, node, txn_id: TxnId, route: Route, result: AsyncResult,
+                 ballot: Optional[Ballot] = None):
+        self.node = node
+        self.txn_id = txn_id
+        self.route = route
+        self.result = result
+        if ballot is None:
+            now = node.unique_now()
+            ballot = Ballot(now.epoch, now.hlc, 0, node.id)
+        self.ballot = ballot
+        self.tracker: Optional[RecoveryTracker] = None
+        self.oks: Dict[int, RecoverOk] = {}
+        self.ballot_promised = False
+        self.done = False
+
+    # ------------------------------------------------------- recovery round --
+    def start(self) -> None:
+        topologies = self.node.topology.with_unsynced_epochs(
+            self.route.participants(), self.txn_id.epoch, self.txn_id.epoch)
+        self.tracker = RecoveryTracker(topologies)
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, self.route)
+            if scope is None:
+                continue
+            self.node.send(to, BeginRecovery(self.txn_id, scope, self.ballot,
+                                             full_route=self.route),
+                           callback=self)
+
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done or self.ballot_promised:
+            return
+        if isinstance(reply, RecoverNack):
+            # bump our HLC past the superseding promise so a later retry
+            # mints a higher ballot
+            self.node.on_remote_timestamp(reply.superseded_by)
+            self.node.events.on_preempted(self.txn_id)
+            self._fail(Preempted(f"recovery of {self.txn_id} superseded by "
+                                 f"{reply.superseded_by}"))
+            return
+        invariants.check_state(isinstance(reply, RecoverOk),
+                               "unexpected reply %s", reply)
+        self.oks[from_id] = reply
+        # this replica could only have cast a fast-path accept if it had
+        # witnessed the txn at its original timestamp (Recover.onSuccess:
+        # fastPath = ok.executeAt == txnId)
+        if self.tracker.record_success(
+                from_id, rejects_fast_path=not reply.witnessed_at_original) \
+                == RequestStatus.SUCCESS:
+            self._recover()
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done or self.ballot_promised:
+            return
+        if self.tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self._fail(failure if isinstance(failure, Timeout)
+                       else Exhausted(repr(failure)))
+
+    # ----------------------------------------------------------- deciphering --
+    def _recover(self) -> None:
+        self.ballot_promised = True
+        oks = list(self.oks.values())
+        merged = oks[0]
+        for ok in oks[1:]:
+            merged = merged.merge(ok)
+
+        status = merged.status
+        if status.is_truncated:
+            # durably applied and shed everywhere that matters
+            self._succeed(None)
+            return
+        if status == SaveStatus.INVALIDATED:
+            self._commit_invalidate(merged)
+            return
+        if status >= SaveStatus.PRE_APPLIED:
+            self._persist_outcome(merged)
+            return
+        if status.is_at_least_committed or status == SaveStatus.PRE_COMMITTED:
+            self._with_committed_deps(
+                merged, lambda deps: self._execute(merged, merged.execute_at,
+                                                   deps))
+            return
+        if status == SaveStatus.ACCEPTED:
+            # re-propose the highest-ballot accepted (executeAt, deps)
+            self._propose(merged, merged.execute_at, merged.deps)
+            return
+        if status == SaveStatus.ACCEPTED_INVALIDATE:
+            self._invalidate(merged)
+            return
+
+        # nothing accepted anywhere: decipher the fast path
+        if self.tracker.rejects_fast_path() or merged.rejects_fast_path:
+            self._invalidate(merged)
+            return
+        # the fast path may have been taken; earlier accepted txns that never
+        # witnessed us must commit before that is sound (Recover.java:322-336)
+        if not merged.earlier_no_witness.is_empty:
+            self._await_commits(merged.earlier_no_witness)
+            return
+        self._propose(merged, self.txn_id.as_timestamp(), merged.deps)
+
+    # --------------------------------------------------------- continuations --
+    def _reconstitute(self, merged: RecoverOk) -> Txn:
+        invariants.check_state(
+            merged.partial_txn is not None,
+            "recovery of %s reached a completion path without a definition",
+            self.txn_id)
+        return merged.partial_txn.reconstitute(self.route)
+
+    def _propose(self, merged: RecoverOk, execute_at: Timestamp, deps: Deps
+                 ) -> None:
+        txn = self._reconstitute(merged)
+
+        def accepted(stable_deps: Deps):
+            if self.done:
+                return
+            self._execute(merged, execute_at, stable_deps, txn=txn)
+
+        Propose(self.node, self.txn_id, txn, self.route, self.ballot,
+                execute_at, deps, accepted, self._fail).start()
+
+    def _execute(self, merged: RecoverOk, execute_at: Timestamp, deps: Deps,
+                 txn: Optional[Txn] = None) -> None:
+        if self.done:
+            return
+        txn = txn if txn is not None else self._reconstitute(merged)
+        path = ExecutePath(self.node, self.txn_id, txn, self.route, execute_at,
+                           deps, CommitKind.STABLE_MAXIMAL, ApplyKind.MAXIMAL,
+                           self.result)
+        self.done = True
+        self.node.events.on_recover(self.txn_id, "execute")
+        path.start()
+
+    def _persist_outcome(self, merged: RecoverOk) -> None:
+        """Outcome already known: re-broadcast Apply.Maximal
+        (Recover.java Applied/PreApplied arm)."""
+        txn = self._reconstitute(merged)
+
+        # replicas store writes with `keys` sliced to their ranges but the
+        # full effect payload intact (Apply.apply -> Writes.slice), so any
+        # single recovered copy can be re-expanded to full coverage — without
+        # this, shards whose replicas never applied would slice the partial
+        # key set to empty and lose the acked write
+        writes = merged.writes
+        if writes is not None and txn.update is not None:
+            from accord_tpu.primitives.writes import Writes
+            writes = Writes(writes.txn_id, writes.execute_at,
+                            txn.update.keys(), writes.write)
+
+        def with_deps(deps: Deps):
+            if self.done:
+                return
+            self.done = True
+            topologies = self.node.topology.with_unsynced_epochs(
+                self.route.participants(), self.txn_id.epoch,
+                merged.execute_at.epoch)
+            for to in topologies.nodes():
+                scope = TxnRequest.compute_scope(to, topologies, self.route)
+                if scope is None:
+                    continue
+                partial = txn.slice(scope.covering(), include_query=False)
+                self.node.send(
+                    to, Apply(ApplyKind.MAXIMAL, self.txn_id, scope,
+                              merged.execute_at, deps, writes,
+                              merged.result, partial_txn=partial,
+                              full_route=self.route))
+            self.node.events.on_recover(self.txn_id, "persist")
+            self.result.try_success(merged.result)
+
+        self._with_committed_deps(merged, with_deps)
+
+    def _with_committed_deps(self, merged: RecoverOk, with_deps) -> None:
+        """Union the committed deps found with a fresh CollectDeps round
+        bounded by executeAt (Recover.withCommittedDeps + CollectDeps).
+
+        Key-coverage of the recovered committed deps cannot be derived from
+        the deps alone (a key with no conflicts is legitimately absent), so we
+        conservatively collect fresh deps for all keys and union: for a
+        committed txn, any superset of its conflicts < executeAt is a sound
+        execution-ordering input."""
+        known = merged.committed_deps
+        collect = CollectDeps(self.node, self.txn_id, self.route,
+                              merged.execute_at)
+
+        def collected(fresh: Deps, failure: BaseException = None):
+            if failure is not None:
+                self._fail(failure)
+                return
+            with_deps(known.with_(fresh) if known is not None else fresh)
+
+        collect.start(collected)
+
+    def _await_commits(self, waiting_on: Deps) -> None:
+        """WaitOnCommit each blocking dep at a quorum of the shards it
+        participates in at THIS key range (its own route may be wider, but
+        only the intersection with ours gates our decision)."""
+        dep_ids = waiting_on.sorted_txn_ids()
+        remaining = [len(dep_ids)]
+
+        def one_done(v=None, failure=None):
+            if self.done:
+                return
+            if failure is not None:
+                self._fail(failure)
+                return
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                self._retry()
+
+        for dep_id in dep_ids:
+            participants = waiting_on.key_deps.participants(dep_id)
+            topologies = self.node.topology.with_unsynced_epochs(
+                participants, self.txn_id.epoch, self.txn_id.epoch)
+            dep_route = Route(self.route.home_key,
+                              keys=participants.as_routing(), is_full=False)
+            tracker = QuorumTracker(topologies)
+            waiter = _AwaitCommit(tracker, one_done)
+            for to in topologies.nodes():
+                scope = TxnRequest.compute_scope(to, topologies, dep_route)
+                if scope is None:
+                    continue
+                self.node.send(to, WaitOnCommit(dep_id, scope),
+                               callback=waiter)
+
+    def _retry(self) -> None:
+        """Re-run the recovery round at the same ballot with a FRESH instance
+        so stale replies and armed timeouts from this round cannot pollute the
+        new tracker (Recover.retry constructs a new Recover)."""
+        if self.done:
+            return
+        self.done = True
+        Recover(self.node, self.txn_id, self.route, self.result,
+                ballot=self.ballot).start()
+
+    def _invalidate(self, merged: RecoverOk) -> None:
+        def promised():
+            if not self.done:
+                self._commit_invalidate(merged)
+
+        ProposeInvalidate(self.node, self.ballot, self.txn_id, self.route,
+                          promised, self._fail).start()
+
+    def _commit_invalidate(self, merged: RecoverOk) -> None:
+        self.done = True
+        commit_invalidate(self.node, self.txn_id, self.route)
+        self.node.events.on_invalidated(self.txn_id)
+        self.result.try_failure(Invalidated(f"{self.txn_id} invalidated by recovery"))
+
+    def _succeed(self, result) -> None:
+        self.done = True
+        self.result.try_success(result)
+
+    def _fail(self, failure: BaseException) -> None:
+        self.done = True
+        if isinstance(failure, Timeout):
+            self.node.events.on_timeout(self.txn_id)
+        self.result.try_failure(failure)
+
+
+class _AwaitCommit(Callback):
+    def __init__(self, tracker: QuorumTracker, on_done):
+        self.tracker = tracker
+        self.on_done = on_done
+        self.fired = False
+
+    def on_success(self, from_id: int, reply) -> None:
+        if not self.fired and self.tracker.record_success(from_id) \
+                == RequestStatus.SUCCESS:
+            self.fired = True
+            self.on_done()
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if not self.fired and self.tracker.record_failure(from_id) \
+                == RequestStatus.FAILED:
+            self.fired = True
+            self.on_done(failure=failure)
+
+
+class CollectDeps(Callback):
+    """Collect fresh deps bounded by `before` from a quorum per shard
+    (coordinate/CollectDeps.java over GET_DEPS_REQ)."""
+
+    def __init__(self, node, txn_id: TxnId, route: Route, before: Timestamp):
+        self.node = node
+        self.txn_id = txn_id
+        self.route = route
+        self.before = before
+        self.tracker: Optional[QuorumTracker] = None
+        self.oks: Dict[int, GetDepsOk] = {}
+        self.on_done = None
+        self.fired = False
+
+    def start(self, on_done) -> None:
+        # range-domain deps collection needs the RangeDeps conflict scan
+        # (SURVEY.md §7 stage 6); failing loudly beats silently stabilising
+        # an empty dependency set
+        invariants.check_state(
+            self.route.is_key_domain,
+            "CollectDeps for range-domain txns requires range txn support")
+        self.on_done = on_done
+        topologies = self.node.topology.with_unsynced_epochs(
+            self.route.participants(), self.txn_id.epoch, self.before.epoch)
+        self.tracker = QuorumTracker(topologies)
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, self.route)
+            if scope is None:
+                continue
+            keys = scope.participant_keys()
+            self.node.send(
+                to, GetDeps(self.txn_id, scope, keys, self.before),
+                callback=self)
+
+    def on_success(self, from_id: int, reply) -> None:
+        if self.fired:
+            return
+        self.oks[from_id] = reply
+        if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
+            self.fired = True
+            self.on_done(Deps.merge([ok.deps for ok in self.oks.values()]))
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.fired:
+            return
+        if self.tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self.fired = True
+            self.on_done(None, failure=failure
+                         if isinstance(failure, Timeout)
+                         else Exhausted(repr(failure)))

@@ -2,9 +2,9 @@
 
 Reference: accord/coordinate/CoordinateTransaction.java:60 (fast path :71-77,
 slow path :79-101), AbstractCoordinatePreAccept.java:121 (contact round),
-CoordinationAdapter.java:48-193 (propose/stabilise/execute/persist steps),
-ExecuteTxn.java:53-140 (Stable+Read via Commit.stableAndRead, then Apply),
-Propose / Stabilise / PersistTxn.
+CoordinationAdapter.java:48-193 (propose/stabilise/execute/persist steps).
+The Accept round and the Stable+Read/Apply tail are shared with recovery
+(coordinate/execute.py: Propose / ExecutePath).
 
 Round structure (matching the reference's message economy):
   fast path:  PreAccept (fast-path electorate quorum)  -> Stable+Read -> Apply*
@@ -14,20 +14,17 @@ Round structure (matching the reference's message economy):
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Timeout
-from accord_tpu.coordinate.tracking import (
-    FastPathTracker, QuorumTracker, ReadTracker, RequestStatus,
-)
-from accord_tpu.messages.accept import Accept, AcceptNack, AcceptOk
-from accord_tpu.messages.apply_msg import Apply, ApplyKind
-from accord_tpu.messages.base import Callback, FailureReply, TxnRequest
-from accord_tpu.messages.commit import Commit, CommitKind
+from accord_tpu.coordinate.execute import ExecutePath, Propose
+from accord_tpu.coordinate.tracking import FastPathTracker, RequestStatus
+from accord_tpu.messages.apply_msg import ApplyKind
+from accord_tpu.messages.base import Callback, TxnRequest
+from accord_tpu.messages.commit import CommitKind
 from accord_tpu.messages.preaccept import PreAccept, PreAcceptNack, PreAcceptOk
-from accord_tpu.messages.read import ReadNack, ReadOk
 from accord_tpu.primitives.deps import Deps
-from accord_tpu.primitives.keys import Keys, Route
+from accord_tpu.primitives.keys import Route
 from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
 from accord_tpu.primitives.txn import Txn
 from accord_tpu.utils import invariants
@@ -44,16 +41,7 @@ class CoordinateTransaction(Callback):
         self.topologies = None
         self.tracker: Optional[FastPathTracker] = None
         self.oks: Dict[int, PreAcceptOk] = {}
-        self.phase = "preaccept"
-        self.execute_at: Optional[Timestamp] = None
-        self.stable_deps: Optional[Deps] = None
-        self._accept_oks: Dict[int, AcceptOk] = {}
-        self._accept_tracker: Optional[QuorumTracker] = None
-        self._read_tracker: Optional[ReadTracker] = None
-        self._read_data = None
-        self._stable_tracker: Optional[QuorumTracker] = None
-        self._read_nodes: List[int] = []
-        self._executed = False
+        self.done = False
 
     # ------------------------------------------------------------ preaccept --
     def start(self) -> None:
@@ -69,12 +57,13 @@ class CoordinateTransaction(Callback):
             partial = self.txn.slice(owned, include_query=(to == self.node.id))
             self.node.send(
                 to, PreAccept(self.txn_id, partial, scope,
-                              self.topologies.current_epoch),
+                              self.topologies.current_epoch,
+                              full_route=self.route),
                 callback=self,
                 timeout_s=self.node.agent.pre_accept_timeout())
 
     def on_success(self, from_id: int, reply) -> None:
-        if self.phase != "preaccept":
+        if self.done:
             return
         if isinstance(reply, PreAcceptNack):
             # a competing ballot holds a promise: another coordinator/recovery
@@ -91,7 +80,7 @@ class CoordinateTransaction(Callback):
             self._fail(Exhausted("preaccept quorum unreachable"))
 
     def on_failure(self, from_id: int, failure: BaseException) -> None:
-        if self.phase != "preaccept":
+        if self.done:
             return
         status = self.tracker.record_failure(from_id)
         if status == RequestStatus.FAILED:
@@ -103,199 +92,35 @@ class CoordinateTransaction(Callback):
 
     def _on_preaccepted(self) -> None:
         """Quorum of PreAcceptOks (CoordinateTransaction.onPreAccepted)."""
-        self.phase = "deciding"
+        self.done = True
         oks = list(self.oks.values())
         merged_deps = Deps.merge([ok.deps for ok in oks])
         if self.tracker.has_fast_path_accepted:
             # fast path: execute at the original timestamp
-            self.execute_at = self.txn_id.as_timestamp()
-            self.stable_deps = merged_deps
             self.node.events.on_fast_path_taken(self.txn_id)
-            self._execute(CommitKind.STABLE_FAST_PATH)
+            self._execute(CommitKind.STABLE_FAST_PATH,
+                          self.txn_id.as_timestamp(), merged_deps)
         else:
             max_witnessed = max(ok.witnessed_at for ok in oks)
             if max_witnessed.is_rejected:
                 self._fail(Invalidated("preaccept rejected"))
                 return
             self.node.events.on_slow_path_taken(self.txn_id)
-            self._propose(max_witnessed, merged_deps)
-
-    # -------------------------------------------------------- slow: propose --
-    def _propose(self, execute_at: Timestamp, deps: Deps) -> None:
-        """Accept round at ballot 0 (Propose / CoordinationAdapter.propose)."""
-        self.phase = "accept"
-        self.execute_at = execute_at
-
-        def ready():
-            topologies = self.node.topology.with_unsynced_epochs(
-                self.route.participants(), self.txn_id.epoch, execute_at.epoch)
-            self._accept_tracker = QuorumTracker(topologies)
-            cb = _PhaseCallback(self._on_accept_ok, self._on_accept_fail)
-            for to in topologies.nodes():
-                scope = TxnRequest.compute_scope(to, topologies, self.route)
-                if scope is None:
-                    continue
-                keys = self.txn.keys.slice(scope.covering())
-                self.node.send(
-                    to, Accept(self.txn_id, Ballot.ZERO, scope, keys,
-                               execute_at, deps,
-                               max_epoch=execute_at.epoch),
-                    callback=cb)
-
-        self.node.with_epoch(execute_at.epoch, ready)
-
-    def _on_accept_ok(self, from_id: int, reply) -> None:
-        if self.phase != "accept":
-            return
-        if isinstance(reply, AcceptNack):
-            self._fail(Preempted(f"Accept nacked: {reply.reason.name}"))
-            return
-        self._accept_oks[from_id] = reply
-        if self._accept_tracker.record_success(from_id) == RequestStatus.SUCCESS:
-            # deps for the stable round: union of accept-round recalculations
-            self.stable_deps = Deps.merge(
-                [ok.deps for ok in self._accept_oks.values()])
-            self._execute(CommitKind.STABLE_SLOW_PATH)
-
-    def _on_accept_fail(self, from_id: int, failure: BaseException) -> None:
-        if self.phase != "accept":
-            return
-        if self._accept_tracker.record_failure(from_id) == RequestStatus.FAILED:
-            self._fail(failure if isinstance(failure, Timeout)
-                       else Exhausted(repr(failure)))
+            Propose(self.node, self.txn_id, self.txn, self.route, Ballot.ZERO,
+                    max_witnessed, merged_deps,
+                    lambda stable_deps: self._execute(
+                        CommitKind.STABLE_SLOW_PATH, max_witnessed,
+                        stable_deps),
+                    self._fail).start()
 
     # ----------------------------------------------------- execute (stable) --
-    def _execute(self, kind: CommitKind) -> None:
-        """Stable+Read round (ExecuteTxn via Commit.stableAndRead :175):
-        Stable to every replica; the read piggybacked on one replica per
-        shard of the execution epoch."""
-        self.phase = "execute"
-
-        def ready():
-            execute_epoch = self.execute_at.epoch
-            topologies = self.node.topology.with_unsynced_epochs(
-                self.route.participants(), self.txn_id.epoch, execute_epoch)
-            execute_topology = topologies.for_epoch(execute_epoch)
-            self._stable_tracker = QuorumTracker(topologies)
-            from accord_tpu.topology.topologies import Topologies
-            read_keys = (self.txn.read.keys() if self.txn.read is not None
-                         else Keys(()))
-            self._read_tracker = (ReadTracker(Topologies([execute_topology]))
-                                  if read_keys else None)
-            prefer = [self.node.id] + sorted(execute_topology.nodes())
-            self._read_nodes = (self._read_tracker.initial_contacts(prefer)
-                                if self._read_tracker else [])
-            cb = _PhaseCallback(self._on_stable_reply, self._on_stable_fail)
-            for to in topologies.nodes():
-                scope = TxnRequest.compute_scope(to, topologies, self.route)
-                if scope is None:
-                    continue
-                owned = scope.covering()
-                partial = self.txn.slice(owned, include_query=False)
-                to_read = (read_keys.slice(owned)
-                           if to in self._read_nodes else None)
-                self.node.send(
-                    to, Commit(kind, self.txn_id, scope, partial,
-                               self.execute_at, self.stable_deps,
-                               read_keys=to_read),
-                    callback=cb)
-
-        self.node.with_epoch(self.execute_at.epoch, ready)
-
-    def _on_stable_reply(self, from_id: int, reply) -> None:
-        if self.phase != "execute":
-            return
-        if isinstance(reply, ReadNack):
-            if reply.reason == ReadNack.INVALID:
-                self._fail(Invalidated("invalidated during execution"))
-            else:
-                self._retry_read(from_id)
-            return
-        if isinstance(reply, ReadOk):
-            if reply.data is not None:
-                self._read_data = (reply.data if self._read_data is None
-                                   else self._read_data.merge(reply.data))
-            if self._read_tracker is not None:
-                self._read_tracker.record_read_success(from_id)
-        self._stable_tracker.record_success(from_id)
-        self._maybe_finish_execute()
-
-    def _on_stable_fail(self, from_id: int, failure: BaseException) -> None:
-        if self.phase != "execute":
-            return
-        if self._stable_tracker.record_failure(from_id) == RequestStatus.FAILED:
-            self._fail(failure if isinstance(failure, Timeout)
-                       else Exhausted(repr(failure)))
-            return
-        if from_id in self._read_nodes:
-            self._retry_read(from_id)
-
-    def _retry_read(self, from_id: int) -> None:
-        """A read replica failed: try an alternative (ReadCoordinator
-        TryAlternative)."""
-        if self._read_tracker is None:
-            return
-        status, retry = self._read_tracker.record_read_failure(from_id)
-        if status == RequestStatus.FAILED:
-            self._fail(Exhausted("read candidates exhausted"))
-            return
-        read_keys = self.txn.read.keys()
-        topologies = self.node.topology.with_unsynced_epochs(
-            self.route.participants(), self.txn_id.epoch, self.execute_at.epoch)
-        cb = _PhaseCallback(self._on_stable_reply, self._on_stable_fail)
-        for to in retry:
-            self._read_nodes.append(to)
-            scope = TxnRequest.compute_scope(to, topologies, self.route)
-            if scope is None:
-                continue
-            owned = scope.covering()
-            from accord_tpu.messages.read import ReadTxnData
-            self.node.send(
-                to, ReadTxnData(self.txn_id, scope, read_keys.slice(owned),
-                                self.execute_at.epoch),
-                callback=cb)
-
-    def _maybe_finish_execute(self) -> None:
-        reads_done = (self._read_tracker is None
-                      or all(t.has_data for t in self._read_tracker.trackers))
-        if reads_done and self._stable_tracker.has_reached_quorum \
-                and not self._executed:
-            self._executed = True
-            self._persist()
-
-    # -------------------------------------------------------------- persist --
-    def _persist(self) -> None:
-        """Compute the result, unblock the client, send Apply.Minimal
-        (PersistTxn / StandardTxnAdapter.persist :188-193)."""
-        self.phase = "persist"
-        writes = self.txn.execute(self.txn_id, self.execute_at, self._read_data)
-        result = (self.txn.result(self.txn_id, self.execute_at, self._read_data)
-                  if self.txn.query is not None else None)
-        topologies = self.node.topology.with_unsynced_epochs(
-            self.route.participants(), self.txn_id.epoch, self.execute_at.epoch)
-        for to in topologies.nodes():
-            scope = TxnRequest.compute_scope(to, topologies, self.route)
-            if scope is None:
-                continue
-            self.node.send(
-                to, Apply(ApplyKind.MINIMAL, self.txn_id, scope,
-                          self.execute_at, self.stable_deps, writes, result))
-        self.result.try_success(result)
+    def _execute(self, kind: CommitKind, execute_at: Timestamp, deps: Deps
+                 ) -> None:
+        ExecutePath(self.node, self.txn_id, self.txn, self.route, execute_at,
+                    deps, kind, ApplyKind.MINIMAL, self.result).start()
 
     def _fail(self, failure: BaseException) -> None:
-        self.phase = "failed"
+        self.done = True
         if isinstance(failure, Timeout):
             self.node.events.on_timeout(self.txn_id)
         self.result.try_failure(failure)
-
-
-class _PhaseCallback(Callback):
-    def __init__(self, on_success, on_failure):
-        self._s = on_success
-        self._f = on_failure
-
-    def on_success(self, from_id: int, reply) -> None:
-        self._s(from_id, reply)
-
-    def on_failure(self, from_id: int, failure: BaseException) -> None:
-        self._f(from_id, failure)

@@ -1,0 +1,173 @@
+"""SimpleProgressLog: the timeout-driven liveness engine.
+
+Reference: accord/impl/SimpleProgressLog.java:77-714 — a per-CommandStore
+instance polled on a recurring schedule (run loop :669); per-txn home-shard
+state machine escalating through Expected -> NoProgress -> Investigating to
+`Node.maybeRecover`, and a BlockedState chasing commits/applies of
+dependencies a local command is stuck behind.
+
+Every replica of the home shard monitors a txn (they dedup through
+`Node.coordinating` and ballot preemption); blocked dependencies are chased by
+whichever store is waiting on them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from accord_tpu.api.spi import ProgressLog
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import TxnId
+
+
+class _HomeState:
+    """Progress tracking for a txn this store is home for
+    (SimpleProgressLog.CoordinateState)."""
+
+    __slots__ = ("txn_id", "route", "status", "updated_at_s", "attempts",
+                 "investigating")
+
+    def __init__(self, txn_id: TxnId, route: Optional[Route], status: SaveStatus,
+                 now_s: float):
+        self.txn_id = txn_id
+        self.route = route
+        self.status = status
+        self.updated_at_s = now_s
+        self.attempts = 0
+        self.investigating = False
+
+
+class _BlockedState:
+    """A local command is stuck waiting for `txn_id` to reach `blocked_until`
+    (SimpleProgressLog.BlockedState)."""
+
+    __slots__ = ("txn_id", "route", "blocked_until", "since_s", "attempts")
+
+    def __init__(self, txn_id: TxnId, route: Optional[Route],
+                 blocked_until: str, now_s: float):
+        self.txn_id = txn_id
+        self.route = route
+        self.blocked_until = blocked_until
+        self.since_s = now_s
+        self.attempts = 0
+
+
+class SimpleProgressLog(ProgressLog):
+    def __init__(self, node, store):
+        self.node = node
+        self.store = store
+        self.home: Dict[TxnId, _HomeState] = {}
+        self.blocked: Dict[TxnId, _BlockedState] = {}
+        delay = node.config.progress_log_schedule_delay_s
+        self._delay_s = delay
+        # stagger replicas so they do not duel over recovery ballots
+        self._grace_s = 2 * delay + node.random.next_float() * delay
+        self._task = node.scheduler.recurring(delay, self._run)
+
+    # ----------------------------------------------------- state callbacks --
+    def update(self, store, txn_id: TxnId, command) -> None:
+        now = self._now_s()
+        if command.is_applied_or_gone or command.durability.is_durable:
+            self.home.pop(txn_id, None)
+            self.blocked.pop(txn_id, None)
+            return
+        blocked = self.blocked.get(txn_id)
+        if blocked is not None and _blocked_satisfied(command, blocked):
+            self.blocked.pop(txn_id, None)
+        if not self._is_home(command):
+            return
+        state = self.home.get(txn_id)
+        if state is None:
+            self.home[txn_id] = _HomeState(txn_id, command.route,
+                                           command.save_status, now)
+        elif command.save_status != state.status:
+            state.status = command.save_status
+            state.route = command.route or state.route
+            state.updated_at_s = now
+            state.attempts = 0
+            state.investigating = False
+
+    def waiting(self, blocked_by: TxnId, store, blocked_until: str,
+                route, participants) -> None:
+        if blocked_by in self.blocked:
+            return
+        cmd = self.store.commands.get(blocked_by)
+        r = route if route is not None else (cmd.route if cmd else None)
+        self.blocked[blocked_by] = _BlockedState(blocked_by, r, blocked_until,
+                                                 self._now_s())
+
+    def durable(self, command) -> None:
+        if command.durability.is_durable:
+            self.home.pop(command.txn_id, None)
+            self.blocked.pop(command.txn_id, None)
+
+    def clear(self, txn_id: TxnId) -> None:
+        self.home.pop(txn_id, None)
+        self.blocked.pop(txn_id, None)
+
+    # -------------------------------------------------------------- polling --
+    def _run(self) -> None:
+        now = self._now_s()
+        for state in list(self.home.values()):
+            self._check_home(state, now)
+        for state in list(self.blocked.values()):
+            self._check_blocked(state, now)
+
+    def _check_home(self, state: _HomeState, now: float) -> None:
+        if state.investigating:
+            return
+        deadline = state.updated_at_s + self._grace_s * (1 + state.attempts)
+        if now < deadline:
+            return
+        if state.route is None:
+            return
+        state.investigating = True
+        state.attempts += 1
+        self._recover(state.txn_id, state.route,
+                      lambda: self._done_home(state))
+
+    def _done_home(self, state: _HomeState) -> None:
+        state.investigating = False
+        state.updated_at_s = self._now_s()
+
+    def _check_blocked(self, state: _BlockedState, now: float) -> None:
+        cmd = self.store.commands.get(state.txn_id)
+        if cmd is not None and _blocked_satisfied(cmd, state):
+            self.blocked.pop(state.txn_id, None)
+            return
+        deadline = state.since_s + self._grace_s * (1 + state.attempts)
+        if now < deadline:
+            return
+        route = state.route or (cmd.route if cmd is not None else None)
+        if route is None:
+            return  # no route knowledge yet; CheckStatus/FetchData territory
+        state.attempts += 1
+        state.since_s = now
+        self._recover(state.txn_id, route, lambda: None)
+
+    def _recover(self, txn_id: TxnId, route: Route, on_settled) -> None:
+        result = self.node.recover(txn_id, route)
+
+        def finished(value, failure):
+            on_settled()
+
+        result.add_callback(finished)
+
+    def _is_home(self, command) -> bool:
+        return (command.route is not None
+                and not self.store.ranges.is_empty
+                and self.store.ranges.contains(command.route.home_key))
+
+    def _now_s(self) -> float:
+        return self.node.now_us() / 1e6
+
+
+def _blocked_satisfied(command, state: _BlockedState) -> bool:
+    if command.is_applied_or_gone or command.is_truncated:
+        return True
+    if state.blocked_until == "Committed":
+        return command.has_been(SaveStatus.COMMITTED)
+    if state.blocked_until == "Applied":
+        return command.has_been(SaveStatus.APPLIED)
+    return command.route is not None  # 'HasRoute'

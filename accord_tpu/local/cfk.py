@@ -240,17 +240,22 @@ class CommandsForKey:
                 out.append(t)
         return out
 
-    def accepted_or_committed_started_before_without_witnessing(
+    def accepted_started_before_without_witnessing(
             self, txn_id: TxnId, witnessed_by: Callable[[TxnId], bool]
     ) -> List[TxnId]:
-        """ACCEPTED+ txns with txnId < txn_id whose deps omit txn_id
-        (earlierAcceptedNoWitness: must await their commit before deciding)."""
+        """ACCEPTED (deps still *proposed*, not yet committed) txns with
+        txnId < txn_id, proposed to execute after txn_id, whose deps omit it
+        (earlierAcceptedNoWitness: recovery must await their commit before
+        deciphering the fast path — BeginRecovery.java:329-342, TestStatus
+        IS_PROPOSED + executeAt > startedBefore filter; once such a txn
+        commits it leaves this set, so the await/retry loop terminates)."""
         hi = find_ceil(self._ids, txn_id)
         out = []
         for i in range(hi):
             t = self._ids[i]
             info = self._by_id[t]
-            if InternalStatus.ACCEPTED <= info.status <= InternalStatus.APPLIED \
+            if info.status == InternalStatus.ACCEPTED \
+                    and info.execute_at_or_txn_id() > txn_id \
                     and txn_id.witnesses(t) and not witnessed_by(t):
                 out.append(t)
         return out

@@ -62,15 +62,17 @@ def calculate_deps(safe_store: SafeCommandStore, txn_id: TxnId, keys: Keys,
 
 
 def propose_execute_at(safe_store: SafeCommandStore, txn_id: TxnId,
-                       participants, permit_fast_path: bool) -> Timestamp:
+                       participants, permit_fast_path: bool,
+                       permit_expiry: bool = True) -> Timestamp:
     """executeAt proposal (CommandStore.preaccept :320-345): txn_id itself when
     no conflict is newer AND the fast path is permitted (ballot zero — recovery
     must not mint fast-path votes — and txn_id's epoch is current), else a
     fresh HLC strictly after every known conflict."""
     node = safe_store.node
     # preaccept expiry: stale-clocked coordinators get a REJECTED proposal the
-    # coordinator turns into invalidation (CommandStore.preaccept isExpired)
-    if not txn_id.kind.is_sync_point:
+    # coordinator turns into invalidation (CommandStore.preaccept isExpired);
+    # never applied to recovery witnesses — the txn may be long since decided
+    if permit_expiry and not txn_id.kind.is_sync_point:
         elapsed_us = node.now_us() - txn_id.hlc
         if elapsed_us >= safe_store.agent.pre_accept_timeout() * 1e6:
             return node.unique_now_at_least(txn_id).as_rejected()
@@ -119,6 +121,46 @@ def preaccept(safe_store: SafeCommandStore, txn_id: TxnId,
         safe_store.register_range_txn(cmd, partial_txn.keys)
     safe_store.progress_log.update(safe_store.store, txn_id, cmd)
     return AcceptOutcome.SUCCESS, witnessed_at
+
+
+# ------------------------------------------------------------------ recover --
+
+def recover(safe_store: SafeCommandStore, txn_id: TxnId,
+            partial_txn: Optional[PartialTxn], route: Route, ballot: Ballot
+            ) -> Tuple[AcceptOutcome, Command]:
+    """Ballot-gated witness for BeginRecovery (Commands.preacceptOrRecover,
+    Commands.java:160-217): promise `ballot`, witnessing the txn if this
+    replica never saw it. Recovery proposals never mint fast-path votes
+    (permit_fast_path=False), so a replica that first witnesses the txn here
+    reports executeAt > txnId — a vote that the fast path did not happen.
+
+    Returns the (possibly just-created) command so the caller can snapshot its
+    pre-existing knowledge into the RecoverOk reply."""
+    cmd = safe_store.get(txn_id)
+    if cmd.is_truncated or cmd.is_invalidated:
+        return AcceptOutcome.TRUNCATED, cmd
+    if not cmd.may_accept(ballot):
+        return AcceptOutcome.REJECTED_BALLOT, cmd
+    cmd.set_promised(ballot)
+    if cmd.has_been(SaveStatus.PRE_ACCEPTED):
+        return AcceptOutcome.SUCCESS, cmd
+
+    cmd.update_route(route)
+    if partial_txn is not None:
+        cmd.partial_txn = partial_txn
+    participants = (partial_txn.keys if partial_txn is not None
+                    else route.participants())
+    witnessed_at = propose_execute_at(safe_store, txn_id, participants,
+                                      permit_fast_path=False,
+                                      permit_expiry=False)
+    cmd.execute_at = witnessed_at
+    cmd.set_status(SaveStatus.PRE_ACCEPTED)
+    safe_store.update_max_conflicts(participants, witnessed_at)
+    safe_store.register(cmd, InternalStatus.PREACCEPTED)
+    if txn_id.is_range_domain and partial_txn is not None:
+        safe_store.register_range_txn(cmd, partial_txn.keys)
+    safe_store.progress_log.update(safe_store.store, txn_id, cmd)
+    return AcceptOutcome.SUCCESS, cmd
 
 
 # ------------------------------------------------------------------- accept --

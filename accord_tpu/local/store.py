@@ -173,25 +173,30 @@ class SafeCommandStore:
                 return True
         return False
 
-    def earlier_committed_witness(self, txn_id: TxnId, keys: Keys) -> List[TxnId]:
+    def earlier_committed_witness(self, txn_id: TxnId, keys: Keys) -> Deps:
+        """Key-associated, so recovery can await on the dep's own shards
+        (reference returns Deps, BeginRecovery.java:344)."""
+        from accord_tpu.primitives.deps import KeyDeps
         wb = lambda t: self._witnessed_by(t, txn_id)
-        out: Set[TxnId] = set()
+        builder = KeyDeps.builder()
         for key in keys.slice(self.ranges):
             cfk = self.store.cfks.get(key)
             if cfk is not None:
-                out.update(cfk.stable_started_before_and_witnessed(txn_id, wb))
-        return sorted(out)
+                for t in cfk.stable_started_before_and_witnessed(txn_id, wb):
+                    builder.add(key, t)
+        return Deps(builder.build(), None)
 
-    def earlier_accepted_no_witness(self, txn_id: TxnId, keys: Keys) -> List[TxnId]:
+    def earlier_accepted_no_witness(self, txn_id: TxnId, keys: Keys) -> Deps:
+        from accord_tpu.primitives.deps import KeyDeps
         wb = lambda t: self._witnessed_by(t, txn_id)
-        out: Set[TxnId] = set()
+        builder = KeyDeps.builder()
         for key in keys.slice(self.ranges):
             cfk = self.store.cfks.get(key)
             if cfk is not None:
-                out.update(
-                    cfk.accepted_or_committed_started_before_without_witnessing(
-                        txn_id, wb))
-        return sorted(out)
+                for t in cfk.accepted_started_before_without_witnessing(
+                        txn_id, wb):
+                    builder.add(key, t)
+        return Deps(builder.build(), None)
 
 
 class CommandStore:

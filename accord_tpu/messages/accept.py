@@ -40,9 +40,10 @@ class Accept(TxnRequest):
 
     def __init__(self, txn_id: TxnId, ballot: Ballot, scope: Route,
                  participating_keys, execute_at: Timestamp, deps: Deps,
-                 max_epoch: int = 0):
+                 max_epoch: int = 0, full_route: Route = None):
         super().__init__(txn_id, scope,
-                         wait_for_epoch=max_epoch or execute_at.epoch)
+                         wait_for_epoch=max_epoch or execute_at.epoch,
+                         full_route=full_route)
         self.ballot = ballot
         self.participating_keys = participating_keys
         self.execute_at = execute_at
@@ -51,16 +52,18 @@ class Accept(TxnRequest):
     def apply(self, safe_store) -> Reply:
         owned_keys = self.participating_keys.slice(safe_store.ranges) \
             if not safe_store.ranges.is_empty else self.participating_keys
-        outcome = C.accept(safe_store, self.txn_id, self.ballot, self.scope,
+        outcome = C.accept(safe_store, self.txn_id, self.ballot, self.route,
                            owned_keys, self.execute_at,
                            self.deps.slice(safe_store.ranges))
-        if outcome == C.AcceptOutcome.SUCCESS:
-            # deps freshly calculated up to executeAt for the commit round
+        if outcome in (C.AcceptOutcome.SUCCESS, C.AcceptOutcome.REDUNDANT):
+            # deps freshly calculated up to executeAt for the commit round.
+            # The REDUNDANT (already PRE_COMMITTED+) arm must ALSO report its
+            # known conflicts: this reply still counts toward the accept
+            # quorum, and a conflict known only to this replica would
+            # otherwise be missing from the stable-deps union
             deps = C.calculate_deps(safe_store, self.txn_id, owned_keys,
                                     before=self.execute_at)
             return AcceptOk(self.txn_id, deps)
-        if outcome == C.AcceptOutcome.REDUNDANT:
-            return AcceptOk(self.txn_id, Deps.NONE)
         return AcceptNack(outcome)
 
     def reduce(self, a: Reply, b: Reply) -> Reply:

@@ -44,8 +44,10 @@ class Apply(TxnRequest):
     def __init__(self, kind: ApplyKind, txn_id: TxnId, scope: Route,
                  execute_at: Timestamp, deps: Optional[Deps],
                  writes: Optional[Writes], result,
-                 partial_txn: Optional[PartialTxn] = None):
-        super().__init__(txn_id, scope, wait_for_epoch=execute_at.epoch)
+                 partial_txn: Optional[PartialTxn] = None,
+                 full_route: Route = None):
+        super().__init__(txn_id, scope, wait_for_epoch=execute_at.epoch,
+                         full_route=full_route)
         self.kind = kind
         self.type = kind.value
         self.execute_at = execute_at
@@ -61,7 +63,7 @@ class Apply(TxnRequest):
         writes = self.writes
         if writes is not None and not safe_store.ranges.is_empty:
             writes = writes.slice(safe_store.ranges)
-        outcome = C.apply(safe_store, self.txn_id, self.scope, self.execute_at,
+        outcome = C.apply(safe_store, self.txn_id, self.route, self.execute_at,
                           deps, writes, self.result,
                           partial_txn=self.partial_txn)
         return ApplyReply({

@@ -106,11 +106,20 @@ class TxnRequest(Request):
     stores (TxnRequest implements MapReduceConsume)."""
 
     def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int = 0,
-                 min_epoch: int = 0):
+                 min_epoch: int = 0, full_route: Optional[Route] = None):
         self.txn_id = txn_id
         self.scope = scope
+        # the un-sliced route travels alongside the per-destination scope so
+        # every witness can recover the txn (reference PreAccept.java:51,
+        # Commit.java:78 carry FullRoute)
+        self.full_route = full_route
         self._wait_for_epoch = wait_for_epoch
         self.min_epoch = min_epoch or (wait_for_epoch or txn_id.epoch)
+
+    @property
+    def route(self) -> Route:
+        """Best route knowledge to record on the command."""
+        return self.full_route if self.full_route is not None else self.scope
 
     @property
     def wait_for_epoch(self) -> int:

@@ -37,8 +37,10 @@ class CommitKind(enum.Enum):
 class Commit(TxnRequest):
     def __init__(self, kind: CommitKind, txn_id: TxnId, scope: Route,
                  partial_txn: Optional[PartialTxn], execute_at: Timestamp,
-                 deps: Deps, read_keys: Optional[Keys] = None):
-        super().__init__(txn_id, scope, wait_for_epoch=execute_at.epoch)
+                 deps: Deps, read_keys: Optional[Keys] = None,
+                 full_route: Route = None):
+        super().__init__(txn_id, scope, wait_for_epoch=execute_at.epoch,
+                         full_route=full_route)
         self.kind = kind
         self.type = kind.value
         self.partial_txn = partial_txn
@@ -48,7 +50,7 @@ class Commit(TxnRequest):
 
     def apply(self, safe_store):
         outcome = C.commit(
-            safe_store, self.txn_id, self.scope, self.partial_txn,
+            safe_store, self.txn_id, self.route, self.partial_txn,
             self.execute_at, self.deps.slice(safe_store.ranges)
             if not safe_store.ranges.is_empty else self.deps,
             stable=self.kind.is_stable)

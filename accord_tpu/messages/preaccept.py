@@ -44,14 +44,15 @@ class PreAccept(TxnRequest):
     type = MessageType.PRE_ACCEPT_REQ
 
     def __init__(self, txn_id: TxnId, partial_txn: PartialTxn, scope: Route,
-                 max_epoch: int):
-        super().__init__(txn_id, scope, wait_for_epoch=max_epoch)
+                 max_epoch: int, full_route: Route = None):
+        super().__init__(txn_id, scope, wait_for_epoch=max_epoch,
+                         full_route=full_route)
         self.partial_txn = partial_txn
         self.max_epoch = max_epoch
 
     def apply(self, safe_store) -> Reply:
         outcome, witnessed_at = C.preaccept(
-            safe_store, self.txn_id, self.partial_txn, self.scope)
+            safe_store, self.txn_id, self.partial_txn, self.route)
         if outcome in (C.AcceptOutcome.SUCCESS, C.AcceptOutcome.REDUNDANT):
             deps = C.calculate_deps(
                 safe_store, self.txn_id, self.partial_txn.keys,

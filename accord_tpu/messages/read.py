@@ -70,9 +70,13 @@ class _ReadWhenReady(TransientListener):
         status = command.save_status
         if status == SaveStatus.INVALIDATED:
             self._finish(command, ReadNack(ReadNack.INVALID))
-        elif status.is_truncated:
+        elif status.is_truncated or status >= SaveStatus.PRE_APPLIED:
+            # obsolete: the outcome is already known (possibly applied) — the
+            # pre-write snapshot no longer exists here (ReadData.java
+            # obsolescence; reading post-apply state would violate
+            # serializability)
             self._finish(command, ReadNack(ReadNack.REDUNDANT))
-        elif status >= SaveStatus.READY_TO_EXECUTE:
+        elif status == SaveStatus.READY_TO_EXECUTE:
             self._do_read(safe_store, command)
 
     def _do_read(self, safe_store, command: Command) -> None:

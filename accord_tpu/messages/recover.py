@@ -1,0 +1,170 @@
+"""BeginRecovery: the recovery voting round.
+
+Reference: accord/messages/BeginRecovery.java:55 — per-shard Commands.recover
+(ballot gate) then the fast-path-decipher predicates via mapReduceFull
+(:104-190); RecoverOk carries {status, accepted ballot, executeAt, deps,
+earlierCommittedWitness, earlierAcceptedNoWitness, rejectsFastPath, writes,
+result}; RecoverNack carries the superseding promise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from accord_tpu.local import commands as C
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.messages.base import MessageType, Reply, TxnRequest
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keys import Key, Keys, Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.txn import PartialTxn
+from accord_tpu.primitives.writes import Writes
+
+
+class RecoverOk(Reply):
+    type = MessageType.BEGIN_RECOVER_RSP
+
+    def __init__(self, txn_id: TxnId, status: SaveStatus,
+                 accepted_ballot: Ballot, execute_at: Optional[Timestamp],
+                 deps: Deps, partial_txn: Optional[PartialTxn],
+                 committed_deps: Optional[Deps],
+                 writes: Optional[Writes], result,
+                 rejects_fast_path: bool,
+                 earlier_committed_witness: Deps,
+                 earlier_no_witness: Deps):
+        self.txn_id = txn_id
+        self.status = status
+        self.accepted_ballot = accepted_ballot
+        self.execute_at = execute_at
+        # deps: freshly calculated like a PreAccept vote — the recovery
+        # proposal deps if the fast path is adopted
+        self.deps = deps
+        self.partial_txn = partial_txn
+        # committed_deps: the decided deps when status >= COMMITTED
+        self.committed_deps = committed_deps
+        self.writes = writes
+        self.result = result
+        self.rejects_fast_path = rejects_fast_path
+        self.earlier_committed_witness = earlier_committed_witness
+        self.earlier_no_witness = earlier_no_witness
+
+    @property
+    def witnessed_at_original(self) -> bool:
+        """Could this replica have cast a fast-path vote in the PreAccept
+        round? True iff it had witnessed the txn at its original timestamp."""
+        return self.execute_at is not None \
+            and self.execute_at == self.txn_id.as_timestamp()
+
+    def merge(self, other: "RecoverOk") -> "RecoverOk":
+        """Cross-shard / cross-node knowledge union (BeginRecovery.reduce;
+        `hi` is Status.max by (status, accepted ballot) — for ACCEPTED the
+        highest-ballot proposal's executeAt is the one recovery must adopt)."""
+        hi, lo = ((self, other)
+                  if (self.status, self.accepted_ballot)
+                  >= (other.status, other.accepted_ballot) else (other, self))
+        accepted_ballot = max(self.accepted_ballot, other.accepted_ballot)
+        partial_txn = (self.partial_txn.with_(other.partial_txn)
+                       if self.partial_txn is not None
+                       and other.partial_txn is not None
+                       else self.partial_txn or other.partial_txn)
+        committed_deps = None
+        if hi.status.is_at_least_committed:
+            # only union deps decided at the same executeAt
+            cds = [ok.committed_deps for ok in (self, other)
+                   if ok.committed_deps is not None
+                   and ok.execute_at == hi.execute_at]
+            if cds:
+                committed_deps = Deps.merge(cds)
+        writes = (hi.writes.merge(lo.writes) if hi.writes is not None
+                  else lo.writes)
+        witness = self.earlier_committed_witness.with_(
+            other.earlier_committed_witness)
+        no_witness = self.earlier_no_witness.with_(
+            other.earlier_no_witness).without(witness.contains)
+        return RecoverOk(
+            self.txn_id, hi.status, accepted_ballot, hi.execute_at,
+            self.deps.with_(other.deps), partial_txn, committed_deps,
+            writes,
+            hi.result if hi.result is not None else lo.result,
+            self.rejects_fast_path or other.rejects_fast_path,
+            witness, no_witness)
+
+    def __repr__(self):
+        return (f"RecoverOk({self.txn_id!r}, {self.status.name}, "
+                f"rejectsFP={self.rejects_fast_path})")
+
+
+class RecoverNack(Reply):
+    type = MessageType.BEGIN_RECOVER_RSP
+
+    def __init__(self, superseded_by: Ballot):
+        self.superseded_by = superseded_by
+
+    def __repr__(self):
+        return f"RecoverNack({self.superseded_by!r})"
+
+
+class BeginRecovery(TxnRequest):
+    type = MessageType.BEGIN_RECOVER_REQ
+
+    def __init__(self, txn_id: TxnId, scope: Route, ballot: Ballot,
+                 partial_txn: Optional[PartialTxn] = None,
+                 full_route: Route = None):
+        super().__init__(txn_id, scope, full_route=full_route)
+        self.ballot = ballot
+        # definition is optional: the recovering coordinator sends its local
+        # slice if it has one; replicas that witnessed keep their own
+        self.partial_txn = partial_txn
+
+    def apply(self, safe_store) -> Reply:
+        outcome, cmd = C.recover(safe_store, self.txn_id, self.partial_txn,
+                                 self.route, self.ballot)
+        if outcome == C.AcceptOutcome.REJECTED_BALLOT:
+            return RecoverNack(cmd.promised)
+        if outcome == C.AcceptOutcome.TRUNCATED:
+            # invalidated or locally shed: report what we know
+            status = cmd.save_status
+            return RecoverOk(self.txn_id, status, cmd.accepted_ballot,
+                             cmd.execute_at, Deps.NONE, None, None,
+                             None, None, False, Deps.NONE, Deps.NONE)
+
+        keys = self._local_keys(safe_store, cmd)
+        deps = Deps.NONE
+        rejects = False
+        earlier_witness = Deps.NONE
+        earlier_no_witness = Deps.NONE
+        if not cmd.has_been(SaveStatus.PRE_COMMITTED):
+            # proposal deps + fast-path decipher predicates only matter
+            # pre-decision; a decided txn's recovery uses committed deps
+            deps = C.calculate_deps(safe_store, self.txn_id, keys,
+                                    before=self.txn_id)
+            rejects = safe_store.rejects_fast_path(self.txn_id, keys)
+            earlier_witness = safe_store.earlier_committed_witness(
+                self.txn_id, keys)
+            earlier_no_witness = safe_store.earlier_accepted_no_witness(
+                self.txn_id, keys)
+        committed_deps = (cmd.stable_deps if cmd.stable_deps is not None
+                          else cmd.partial_deps) \
+            if cmd.has_been(SaveStatus.COMMITTED) else None
+        return RecoverOk(
+            self.txn_id, cmd.save_status, cmd.accepted_ballot, cmd.execute_at,
+            deps, cmd.partial_txn, committed_deps, cmd.writes, cmd.result,
+            rejects, earlier_witness, earlier_no_witness)
+
+    def _local_keys(self, safe_store, cmd) -> Keys:
+        if cmd.partial_txn is not None and isinstance(cmd.partial_txn.keys, Keys):
+            return cmd.partial_txn.keys
+        if self.partial_txn is not None and isinstance(self.partial_txn.keys, Keys):
+            return self.partial_txn.keys
+        return self.scope.participant_keys()
+
+    def reduce(self, a: Reply, b: Reply) -> Reply:
+        if isinstance(a, RecoverNack):
+            return a
+        if isinstance(b, RecoverNack):
+            return b
+        assert isinstance(a, RecoverOk) and isinstance(b, RecoverOk)
+        return a.merge(b)
+
+    def __repr__(self):
+        return f"BeginRecovery({self.txn_id!r}, b={self.ballot!r})"

@@ -386,6 +386,12 @@ class Route:
             return self.ranges
         return self.keys.to_ranges()
 
+    def participant_keys(self) -> "Keys":
+        """Data-key view of a key-domain route (empty for range routes)."""
+        if self.keys is None:
+            return Keys(())
+        return Keys([Key(k.token) for k in self.keys])
+
     def slice(self, ranges: Ranges) -> "Route":
         if self.keys is not None:
             return Route(self.home_key, keys=self.keys.slice(ranges), is_full=False)

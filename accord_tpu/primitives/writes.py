@@ -39,5 +39,14 @@ class Writes:
         return Writes(self.txn_id, self.execute_at, self.keys.slice(ranges),
                       self.write)
 
+    def merge(self, other: "Writes") -> "Writes":
+        """Reunite per-shard slices (the `write` payload is the full effect
+        object on every replica; only `keys` is sliced)."""
+        if other is None or other.keys == self.keys:
+            return self
+        return Writes(self.txn_id, self.execute_at,
+                      self.keys.with_(other.keys),
+                      self.write if self.write is not None else other.write)
+
     def __repr__(self):
         return f"Writes({self.txn_id!r}@{self.execute_at!r}, {self.keys!r})"

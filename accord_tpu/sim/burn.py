@@ -41,7 +41,13 @@ class BurnRun:
     def __init__(self, seed: int, ops: int, nodes: int = 3, keys: int = 20,
                  drop_prob: float = 0.0, rf: int = None, n_shards: int = 4,
                  concurrency: int = 8,
-                 progress_log_factory=None, num_command_stores: int = 1):
+                 progress_log_factory="default", num_command_stores: int = 1):
+        if progress_log_factory == "default":
+            # the progress log is a required component under message loss: an
+            # acked txn whose Apply messages are all dropped is only repaired
+            # by recovery (the reference burn always runs SimpleProgressLog)
+            from accord_tpu.impl.progress_log import SimpleProgressLog
+            progress_log_factory = SimpleProgressLog
         self.seed = seed
         self.ops = ops
         self.rng = RandomSource(seed)
@@ -120,7 +126,16 @@ class BurnRun:
 
         for _ in range(min(self.concurrency, self.ops)):
             submit_one()
-        cluster.process_all(max_items=50_000_000)
+        # predicate-driven: recurring progress-log polls keep the queue
+        # non-empty forever, so "drain" means "all client ops settled" —
+        # then a bounded virtual-time grace window lets trailing Apply
+        # messages (and any progress-log-driven recovery) propagate
+        cluster.process_until(
+            lambda: submitted[0] >= self.ops and inflight[0] == 0,
+            max_items=50_000_000)
+        cluster.queue.drain(
+            until_us=cluster.queue.clock.now_us + 10_000_000,
+            max_items=2_000_000)
         self.stats.pending = inflight[0]
         tally = (self.stats.acks + self.stats.nacks + self.stats.lost
                  + self.stats.pending)

@@ -51,6 +51,20 @@ class SimNetwork:
         self.default_link = LinkConfig()
         self.stats: Dict[str, int] = {}
         self.on_deliver: Optional[Callable] = None  # tracing hook
+        # drop filters: fn(from_id, to_id, message) -> True to drop
+        # (reference test/accord/NetworkFilter)
+        self.filters: list = []
+
+    def add_filter(self, fn: Callable) -> Callable:
+        self.filters.append(fn)
+        return fn
+
+    def remove_filter(self, fn: Callable) -> None:
+        if fn in self.filters:
+            self.filters.remove(fn)
+
+    def _filtered(self, from_id: int, to_id: int, message) -> bool:
+        return any(f(from_id, to_id, message) for f in self.filters)
 
     def register(self, node) -> None:
         self.nodes[node.id] = node
@@ -79,7 +93,7 @@ class SimNetwork:
         link = self.link(from_id, to_id)
         action = link.action(self.random)
         msg_name = type(request).__name__
-        if action == Action.DROP:
+        if action == Action.DROP or self._filtered(from_id, to_id, request):
             self._count(f"drop.{msg_name}")
             return
         self._count(f"deliver.{msg_name}")
@@ -100,7 +114,8 @@ class SimNetwork:
     def deliver_reply(self, from_id: int, to_id: int, msg_id: int,
                       reply: Reply) -> None:
         link = self.link(from_id, to_id)
-        if link.action(self.random) == Action.DROP:
+        if link.action(self.random) == Action.DROP \
+                or self._filtered(from_id, to_id, reply):
             self._count(f"drop.{type(reply).__name__}")
             return
         self._count(f"deliver.{type(reply).__name__}")

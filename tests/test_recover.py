@@ -1,0 +1,280 @@
+"""Recovery: abandoned-coordinator scenarios driven through the simulator.
+
+Reference model: accord/coordinate/RecoverTest + the Recover.java decision
+tree (SURVEY.md §3.3): fast-path deciphering, accepted re-proposal, outcome
+propagation, invalidation of unwitnessed txns, and progress-log-driven
+escalation.
+"""
+
+import pytest
+
+from accord_tpu.coordinate.errors import Invalidated
+from accord_tpu.impl.list_store import ListQuery, ListRead, ListResult, ListUpdate
+from accord_tpu.impl.progress_log import SimpleProgressLog
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.messages.commit import Commit
+from accord_tpu.messages.preaccept import PreAccept
+from accord_tpu.messages.apply_msg import Apply
+from accord_tpu.primitives.keys import Key, Keys
+from accord_tpu.primitives.timestamp import Domain, TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.burn import BurnRun
+from accord_tpu.sim.cluster import SimCluster
+
+
+def rw_txn(read_tokens, appends: dict):
+    keys = Keys.of(*(set(read_tokens) | set(appends)))
+    return Txn(TxnKind.WRITE if appends else TxnKind.READ, keys,
+               read=ListRead(Keys.of(*read_tokens)) if read_tokens else None,
+               query=ListQuery(),
+               update=ListUpdate({Key(t): v for t, v in appends.items()})
+               if appends else None)
+
+
+def run_txn(cluster, node_id, txn):
+    result = cluster.node(node_id).coordinate(txn)
+    ok = cluster.process_until(lambda: result.is_done)
+    assert ok, "txn did not complete"
+    return result.value()
+
+
+def abandoned_txn(cluster, node_id, txn, drop):
+    """Submit `txn` from node_id while `drop(from, to, msg)` filters the
+    network; returns (txn_id, route, client_result) once the client settles
+    (normally a timeout/exhaustion nack)."""
+    node = cluster.node(node_id)
+    domain = Domain.KEY
+    txn_id = node.next_txn_id(txn.kind, domain)
+    route = node.compute_route(txn)
+    fltr = cluster.network.add_filter(drop)
+    result = node.coordinate(txn, txn_id=txn_id)
+    assert cluster.process_until(lambda: result.is_done)
+    cluster.network.remove_filter(fltr)
+    return txn_id, route, result
+
+
+def recover(cluster, node_id, txn_id, route):
+    res = cluster.node(node_id).recover(txn_id, route)
+    assert cluster.process_until(lambda: res.is_done)
+    return res
+
+
+class TestRecoverDecisions:
+    def test_completes_fast_path_preaccepted_txn(self):
+        """Coordinator died after PreAccept reached everyone: every replica
+        witnessed at the original timestamp, so the fast path may have been
+        taken and recovery must complete the txn, not invalidate it."""
+        cluster = SimCluster(n_nodes=3, seed=11)
+        txn_id, route, client = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, Commit))
+        assert client.failure() is not None  # client saw a timeout
+
+        res = recover(cluster, 2, txn_id, route)
+        assert res.failure() is None
+        cluster.process_until(
+            lambda: all(n.data_store.get(Key(10)) == (7,)
+                        for n in cluster.nodes.values()))
+        for n in cluster.nodes.values():
+            assert n.data_store.get(Key(10)) == (7,)
+
+    def test_invalidates_unwitnessed_txn(self):
+        """PreAccept never left the coordinator: no other replica witnessed,
+        so the fast path provably did not happen and recovery invalidates."""
+        cluster = SimCluster(n_nodes=3, seed=12)
+        txn_id, route, client = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, PreAccept) and t != 1)
+        assert client.failure() is not None
+
+        res = recover(cluster, 2, txn_id, route)
+        assert isinstance(res.failure(), Invalidated)
+        cluster.process_all()
+        for n in cluster.nodes.values():
+            assert n.data_store.get(Key(10)) == ()
+        # the coordinator's own replica learns the invalidation
+        cmd1 = cluster.node(1).command_stores.stores[0].commands.get(txn_id)
+        assert cmd1 is not None and cmd1.save_status == SaveStatus.INVALIDATED
+
+    def test_reproposes_accepted_txn(self):
+        """Coordinator died between Accept and Stable: recovery finds the
+        accepted (executeAt, deps) and completes the transaction."""
+        cluster = SimCluster(n_nodes=3, seed=13)
+        node1 = cluster.node(1)
+        # pre-mint the txn id, then commit a conflicting later txn so the
+        # pre-minted id is forced onto the slow path
+        txn = rw_txn([10], {10: 7})
+        txn_id = node1.next_txn_id(txn.kind, Domain.KEY)
+        run_txn(cluster, 2, rw_txn([], {10: 1}))
+
+        route = node1.compute_route(txn)
+        fltr = cluster.network.add_filter(
+            lambda f, t, m: isinstance(m, Commit))
+        client = node1.coordinate(txn, txn_id=txn_id)
+        assert cluster.process_until(lambda: client.is_done)
+        cluster.network.remove_filter(fltr)
+        assert client.failure() is not None
+        # replicas hold the slow-path acceptance
+        statuses = {n.command_stores.stores[0].commands[txn_id].save_status
+                    for n in cluster.nodes.values()}
+        assert SaveStatus.ACCEPTED in statuses
+
+        res = recover(cluster, 3, txn_id, route)
+        assert res.failure() is None
+        value = res.value()
+        assert isinstance(value, ListResult)
+        # the recovered read observes the earlier committed append (the txn's
+        # own write applies after its read snapshot)
+        assert value.read_values[Key(10)] == (1,)
+        cluster.process_all()
+        for n in cluster.nodes.values():
+            assert n.data_store.get(Key(10)) == (1, 7)
+
+    def test_propagates_applied_outcome(self):
+        """Apply messages all lost after the client was acked: recovery
+        re-executes and the outcome must match what the client saw."""
+        cluster = SimCluster(n_nodes=3, seed=14)
+        node1 = cluster.node(1)
+        run_txn(cluster, 1, rw_txn([], {10: 1}))
+        txn = rw_txn([10], {10: 2})
+        txn_id = node1.next_txn_id(txn.kind, Domain.KEY)
+        route = node1.compute_route(txn)
+        fltr = cluster.network.add_filter(
+            lambda f, t, m: isinstance(m, Apply))
+        client = node1.coordinate(txn, txn_id=txn_id)
+        assert cluster.process_until(lambda: client.is_done)
+        cluster.network.remove_filter(fltr)
+        # the client WAS acked (persist happens after the read quorum)
+        assert client.failure() is None
+        original = client.value()
+        assert original.read_values[Key(10)] == (1,)
+        for n in cluster.nodes.values():
+            assert n.data_store.get(Key(10)) == (1,)  # write never applied
+
+        res = recover(cluster, 2, txn_id, route)
+        assert res.failure() is None
+        recovered = res.value()
+        # the recovery quorum may not include the home slice carrying the
+        # query, in which case no client result is recomputed (the reference
+        # likewise reports a ProgressToken, not a Result)
+        if recovered is not None:
+            assert recovered.read_values[Key(10)] == (1,)
+        cluster.process_all()
+        for n in cluster.nodes.values():
+            assert n.data_store.get(Key(10)) == (1, 2)
+
+    def test_recovers_full_writes_across_shards(self):
+        """A txn writing two shards whose Apply reached only one replica:
+        recovery must restore the write on BOTH shards (replicas store writes
+        with keys sliced to their ranges; the recovered copy must be
+        re-expanded, not re-broadcast partially)."""
+        cluster = SimCluster(n_nodes=4, rf=3, n_shards=2, seed=16)
+        node1 = cluster.node(1)
+        txn = rw_txn([], {10: 5, 600: 6})  # shard 0 and shard 1
+        txn_id = node1.next_txn_id(txn.kind, Domain.KEY)
+        route = node1.compute_route(txn)
+        fltr = cluster.network.add_filter(
+            lambda f, t, m: isinstance(m, Apply) and t != 1)
+        client = node1.coordinate(txn, txn_id=txn_id)
+        assert cluster.process_until(lambda: client.is_done)
+        cluster.network.remove_filter(fltr)
+        assert client.failure() is None  # acked before Apply propagation
+
+        res = recover(cluster, 2, txn_id, route)
+        assert res.failure() is None
+        cluster.process_all()
+        topology = cluster.topology
+        for n in cluster.nodes.values():
+            owned = topology.ranges_for_node(n.id)
+            if owned.contains(Key(10)):
+                assert n.data_store.get(Key(10)) == (5,), f"node {n.id}"
+            if owned.contains(Key(600)):
+                assert n.data_store.get(Key(600)) == (6,), f"node {n.id}"
+
+    def test_recovery_is_idempotent_with_competing_recoveries(self):
+        """Two nodes race to recover the same stuck txn; both settle and the
+        outcome is applied exactly once."""
+        cluster = SimCluster(n_nodes=3, seed=15)
+        txn_id, route, _ = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, Commit))
+        r2 = cluster.node(2).recover(txn_id, route)
+        r3 = cluster.node(3).recover(txn_id, route)
+        assert cluster.process_until(lambda: r2.is_done and r3.is_done)
+        # at least one recovery must have completed the txn; a loser may be
+        # preempted by the winner's ballot
+        winners = [r for r in (r2, r3) if r.failure() is None]
+        assert winners
+        cluster.process_all()
+        for n in cluster.nodes.values():
+            assert n.data_store.get(Key(10)) == (7,)
+
+
+class TestProgressLog:
+    def test_progress_log_recovers_stuck_txn(self):
+        """No explicit recover call: the home-shard progress log notices the
+        stall and drives recovery on its own."""
+        cluster = SimCluster(n_nodes=3, seed=21,
+                             progress_log_factory=SimpleProgressLog)
+        node1 = cluster.node(1)
+        txn = rw_txn([], {10: 7})
+        txn_id = node1.next_txn_id(txn.kind, Domain.KEY)
+        fltr = cluster.network.add_filter(
+            lambda f, t, m: isinstance(m, Commit) and f == 1)
+        client = node1.coordinate(txn, txn_id=txn_id)
+        assert cluster.process_until(lambda: client.is_done)
+        cluster.network.remove_filter(fltr)
+        assert client.failure() is not None
+
+        done = cluster.process_until(
+            lambda: all(n.data_store.get(Key(10)) == (7,)
+                        for n in cluster.nodes.values()),
+            max_items=500_000)
+        assert done, "progress log failed to recover the stuck txn"
+
+    def test_progress_log_chases_blocked_dependency(self):
+        """A later txn stably depends on a stuck txn; the blocked replica's
+        progress log recovers the dependency so the dependent can execute."""
+        cluster = SimCluster(n_nodes=3, seed=22,
+                             progress_log_factory=SimpleProgressLog)
+        node1 = cluster.node(1)
+        stuck = rw_txn([], {10: 1})
+        stuck_id = node1.next_txn_id(stuck.kind, Domain.KEY)
+        # lose every Apply for the stuck txn: it stays un-applied but stable
+        fltr = cluster.network.add_filter(
+            lambda f, t, m: isinstance(m, Apply) and m.txn_id == stuck_id)
+        client = node1.coordinate(stuck, txn_id=stuck_id)
+        assert cluster.process_until(lambda: client.is_done)
+        cluster.network.remove_filter(fltr)
+        assert client.failure() is None  # acked; just never applied
+
+        dependent = cluster.node(2).coordinate(rw_txn([10], {10: 2}))
+        assert cluster.process_until(lambda: dependent.is_done,
+                                     max_items=500_000)
+        assert dependent.failure() is None
+        assert dependent.value().read_values[Key(10)] == (1,)
+        done = cluster.process_until(
+            lambda: all(n.data_store.get(Key(10)) == (1, 2)
+                        for n in cluster.nodes.values()),
+            max_items=500_000)
+        assert done
+
+
+class TestBurnWithRecovery:
+    def test_burn_with_drops_and_progress_log(self):
+        """Lossy network + progress log: every submitted op settles, strict
+        serializability holds, and a healthy share of ops still commit."""
+        run = BurnRun(seed=31, ops=120, nodes=3, keys=12, drop_prob=0.05,
+                      progress_log_factory=SimpleProgressLog)
+        stats = run.run()
+        assert stats.pending == 0
+        assert stats.acks > 0
+
+    def test_burn_seeds_with_recovery(self):
+        for seed in range(3):
+            run = BurnRun(seed=100 + seed, ops=60, nodes=3, keys=8,
+                          drop_prob=0.08,
+                          progress_log_factory=SimpleProgressLog)
+            stats = run.run()
+            assert stats.pending == 0
+            assert stats.acks > 0
