@@ -1,0 +1,35 @@
+"""Device tier: batched TPU kernels for the two north-star hot loops.
+
+The reference's hot loops (SURVEY §0) are scalar Java scans:
+  1. deps calculation — CommandsForKey.mapReduceActive
+     (reference accord/local/CommandsForKey.java:614-650), invoked per key per
+     PreAccept/Accept/GetDeps;
+  2. execution-order resolution — the Command.WaitingOn bitset graph walk
+     (reference accord/local/Command.java:1294-1643, Commands.java:656,1011).
+
+The TPU-native design is NOT a translation of those scans.  The device works
+on dense integer *ranks* (the host owns the 128-bit timestamp <-> rank
+mapping, ops/encode.py), so that:
+  - the per-key deps scan becomes one broadcast compare + mask over a
+    [batch, entries] tile (ops/deps_kernel.py), and
+  - the WaitingOn topological walk becomes an iterated bool-matmul wavefront
+    on the MXU (ops/wavefront.py).
+Sharding partitions the key/entry axis across a jax Mesh — the same axis
+Accord shards CommandStores on — with psum/all-reduce to combine per-shard
+dependency sets (ops/sharded.py).
+
+Every kernel has a scalar oracle and must stay bit-identical to the host
+path (tests/test_ops.py).
+"""
+
+from accord_tpu.ops.encode import BatchEncoder, DeviceState, DeviceBatch
+from accord_tpu.ops.deps_kernel import batched_active_deps, in_batch_graph
+from accord_tpu.ops.wavefront import execution_waves, waves_oracle
+from accord_tpu.ops.sharded import make_sharded_step, resolve_step
+
+__all__ = [
+    "BatchEncoder", "DeviceState", "DeviceBatch",
+    "batched_active_deps", "in_batch_graph",
+    "execution_waves", "waves_oracle",
+    "make_sharded_step", "resolve_step",
+]

@@ -1,0 +1,158 @@
+"""Host <-> device encoding for the batched kernels.
+
+The device never sees 128-bit timestamps.  The host assembles the *universe*
+of TxnIds relevant to a batch window (every id in the per-key conflict
+indexes plus the batch's own ids), sorts it with full Timestamp order
+(epoch, hlc, flags, node — accord_tpu.primitives.timestamp), and ships dense
+int32 *ranks*.  Rank comparison on device is then bit-identical to Timestamp
+comparison on host, which is what makes the device path provably equivalent
+to the scalar scans (reference CommandsForKey.java:614-650 iterates ids in
+exactly this sorted order).
+
+Layouts (all padded to lane multiples, pad entries are inert):
+  DeviceState  — one row per (key, txn) conflict-index entry:
+      entry_rank[E] i32, entry_key[E] i32, entry_status[E] i32,
+      entry_kind[E] i32
+  DeviceBatch  — one row per new transaction in the window:
+      txn_rank[B] i32, txn_witness_mask[B] i32 (bit k = witnesses TxnKind k),
+      touches[B, K] bool
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from accord_tpu.local.cfk import CommandsForKey, InternalStatus
+from accord_tpu.primitives.keys import Key, Keys
+from accord_tpu.primitives.timestamp import TxnId, TxnKind
+
+PAD = 128
+STATUS_INACTIVE = int(InternalStatus.INVALID_OR_TRUNCATED)
+
+
+def _pad_to(n: int, pad: int) -> int:
+    return max(pad, ((n + pad - 1) // pad) * pad)
+
+
+class DeviceState:
+    """Dense encoding of a set of per-key conflict indexes."""
+
+    __slots__ = ("entry_rank", "entry_key", "entry_status", "entry_kind",
+                 "num_entries", "num_keys")
+
+    def __init__(self, entry_rank: np.ndarray, entry_key: np.ndarray,
+                 entry_status: np.ndarray, entry_kind: np.ndarray,
+                 num_entries: int, num_keys: int):
+        self.entry_rank = entry_rank
+        self.entry_key = entry_key
+        self.entry_status = entry_status
+        self.entry_kind = entry_kind
+        self.num_entries = num_entries
+        self.num_keys = num_keys
+
+
+class DeviceBatch:
+    """Dense encoding of a window of new transactions."""
+
+    __slots__ = ("txn_rank", "txn_witness_mask", "txn_kind", "touches",
+                 "num_txns")
+
+    def __init__(self, txn_rank: np.ndarray, txn_witness_mask: np.ndarray,
+                 txn_kind: np.ndarray, touches: np.ndarray, num_txns: int):
+        self.txn_rank = txn_rank
+        self.txn_witness_mask = txn_witness_mask
+        self.txn_kind = txn_kind
+        self.touches = touches
+        self.num_txns = num_txns
+
+
+def witness_mask(kind: TxnKind) -> int:
+    mask = 0
+    for k in kind.witnesses():
+        mask |= 1 << int(k)
+    return mask
+
+
+class BatchEncoder:
+    """Encodes one flush window: conflict-index state + new txns -> arrays.
+
+    Also the decoder: dependency masks come back as [B, E] booleans over the
+    same entry universe and are translated to sorted TxnId lists.
+    """
+
+    def __init__(self, cfks: Sequence[CommandsForKey],
+                 batch: Sequence[Tuple[TxnId, Sequence[Key]]],
+                 pad: int = PAD):
+        self.pad = pad
+        self.keys: List[Key] = sorted({c.key for c in cfks}
+                                      | {k for _, ks in batch for k in ks})
+        self.key_index: Dict[Key, int] = {k: i for i, k in enumerate(self.keys)}
+        self.batch = list(batch)
+
+        ids = set()
+        entries: List[Tuple[int, TxnId, InternalStatus]] = []
+        for cfk in cfks:
+            ki = self.key_index[cfk.key]
+            for tid in cfk.all_ids():
+                info = cfk.get(tid)
+                entries.append((ki, tid, info.status))
+                ids.add(tid)
+        for tid, _ in batch:
+            ids.add(tid)
+        self.universe: List[TxnId] = sorted(ids)
+        self.rank: Dict[TxnId, int] = {t: i for i, t in enumerate(self.universe)}
+        self.entries = entries
+
+        e = _pad_to(max(1, len(entries)), pad)
+        k = _pad_to(max(1, len(self.keys)), pad)
+        b = _pad_to(max(1, len(batch)), pad)
+
+        entry_rank = np.full(e, -1, np.int32)
+        entry_key = np.zeros(e, np.int32)
+        entry_status = np.full(e, STATUS_INACTIVE, np.int32)
+        entry_kind = np.zeros(e, np.int32)
+        for i, (ki, tid, status) in enumerate(entries):
+            entry_rank[i] = self.rank[tid]
+            entry_key[i] = ki
+            entry_status[i] = int(status)
+            entry_kind[i] = int(tid.kind)
+        self.state = DeviceState(entry_rank, entry_key, entry_status,
+                                 entry_kind, len(entries), len(self.keys))
+
+        txn_rank = np.full(b, -1, np.int32)
+        txn_wmask = np.zeros(b, np.int32)
+        txn_kind = np.zeros(b, np.int32)
+        touches = np.zeros((b, k), bool)
+        for i, (tid, ks) in enumerate(batch):
+            txn_rank[i] = self.rank[tid]
+            txn_wmask[i] = witness_mask(tid.kind)
+            txn_kind[i] = int(tid.kind)
+            for key in ks:
+                touches[i, self.key_index[key]] = True
+        self.dbatch = DeviceBatch(txn_rank, txn_wmask, txn_kind, touches,
+                                  len(batch))
+
+    # -- decode --
+    def decode_deps(self, dep_mask: np.ndarray) -> List[List[TxnId]]:
+        """[B, E] bool -> per-batch-txn sorted unique dependency TxnIds."""
+        out: List[List[TxnId]] = []
+        for b in range(len(self.batch)):
+            row = dep_mask[b]
+            ids = {self.entries[e][1]
+                   for e in np.nonzero(row[:len(self.entries)])[0]}
+            out.append(sorted(ids))
+        return out
+
+    def decode_key_deps(self, dep_mask: np.ndarray
+                        ) -> List[Dict[Key, List[TxnId]]]:
+        """[B, E] bool -> per-batch-txn {key: sorted dep ids} maps."""
+        out: List[Dict[Key, List[TxnId]]] = []
+        for b in range(len(self.batch)):
+            m: Dict[Key, List[TxnId]] = {}
+            for e in np.nonzero(dep_mask[b][:len(self.entries)])[0]:
+                ki, tid, _ = self.entries[e]
+                m.setdefault(self.keys[ki], []).append(tid)
+            out.append({k: sorted(v) for k, v in m.items()})
+        return out

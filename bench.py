@@ -1,0 +1,116 @@
+"""Benchmark: conflict-graph edges resolved per second on the device tier.
+
+Workload (BASELINE.md): synthetic Zipfian key contention — a window of
+transactions over a Zipf(0.99) key universe with a deep per-key conflict
+history, the shape of the reference's hot loop (CommandsForKey.mapReduceActive,
+reference accord/local/CommandsForKey.java:614-650, invoked per key per
+PreAccept).  The device resolves the whole window in one fused step: deps
+masks + in-window conflict graph + MXU execution wavefront.
+
+vs_baseline = speedup over the scalar host path on this machine (edges/s),
+the stand-in for the reference's one-txn-at-a-time scan (the Java repo
+publishes no numbers — BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def build_world(n_keys=1024, n_existing=65536, n_batch=512, seed=42,
+                zipf_alpha=0.99):
+    from accord_tpu.local.cfk import CommandsForKey, InternalStatus
+    from accord_tpu.primitives.keys import Key
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    from accord_tpu.utils.random_source import RandomSource
+
+    rng = RandomSource(seed)
+    keys = [Key(i) for i in range(n_keys)]
+    cfks = {k: CommandsForKey(k) for k in keys}
+    kinds = [TxnKind.READ, TxnKind.WRITE]
+    statuses = [InternalStatus.PREACCEPTED, InternalStatus.ACCEPTED,
+                InternalStatus.COMMITTED, InternalStatus.STABLE,
+                InternalStatus.APPLIED]
+
+    # bounded-Zipf key picker (same scheme as the burn harness)
+    weights = 1.0 / np.arange(1, n_keys + 1) ** zipf_alpha
+    cdf = np.cumsum(weights / weights.sum())
+
+    def pick_key():
+        return keys[int(np.searchsorted(cdf, rng.next_float()))]
+
+    hlc = 1000
+    for _ in range(n_existing):
+        hlc += 1 + rng.next_int(2)
+        tid = TxnId.create(1, hlc, rng.pick(kinds), Domain.KEY,
+                           rng.next_int(8))
+        for k in {pick_key() for _ in range(1 + rng.next_int(3))}:
+            cfks[k].update(tid, rng.pick(statuses), None)
+    batch = []
+    for _ in range(n_batch):
+        hlc += 1 + rng.next_int(2)
+        tid = TxnId.create(1, hlc, rng.pick(kinds), Domain.KEY,
+                           rng.next_int(8))
+        batch.append((tid, sorted({pick_key() for _ in range(1 + rng.next_int(4))})))
+    return list(cfks.values()), batch
+
+
+def scalar_edges_per_sec(cfks, batch):
+    by_key = {c.key: c for c in cfks}
+    edges = 0
+
+    def count(_):
+        nonlocal edges
+        edges += 1
+
+    t0 = time.perf_counter()
+    for tid, keyset in batch:
+        for k in keyset:
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), count)
+    dt = time.perf_counter() - t0
+    return edges / dt, edges
+
+
+def main():
+    import jax
+
+    from accord_tpu.ops.encode import BatchEncoder
+    from accord_tpu.ops.sharded import resolve_step
+
+    cfks, batch = build_world()
+    enc = BatchEncoder(cfks, batch)
+    s, b = enc.state, enc.dbatch
+    args = [jax.device_put(x) for x in
+            (s.entry_rank, s.entry_key, s.entry_status, s.entry_kind,
+             b.txn_rank, b.txn_witness_mask, b.txn_kind, b.touches)]
+
+    # compile + warm up
+    out = resolve_step(*args)
+    jax.block_until_ready(out)
+    edges = int(np.asarray(out[1]).sum())
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = resolve_step(*args)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    device_eps = edges * iters / dt
+
+    scalar_eps, scalar_edges = scalar_edges_per_sec(cfks, batch)
+    assert scalar_edges == edges, (
+        f"device/scalar edge mismatch: {edges} vs {scalar_edges}")
+
+    print(json.dumps({
+        "metric": "conflict_graph_edges_resolved_per_sec",
+        "value": round(device_eps, 1),
+        "unit": "edges/s",
+        "vs_baseline": round(device_eps / scalar_eps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,9 +7,17 @@ path; bench.py runs on the real chip).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests even when the ambient env selects a TPU platform
+# (e.g. JAX_PLATFORMS=axon, which wins over the env var): tests need the
+# 8-device virtual mesh.
+_platform = os.environ.get("ACCORD_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("ACCORD_PARANOIA", "PARANOID")
+
+import jax
+
+jax.config.update("jax_platforms", _platform)
