@@ -326,12 +326,6 @@ class CollectDeps(Callback):
         self.fired = False
 
     def start(self, on_done) -> None:
-        # range-domain deps collection needs the RangeDeps conflict scan
-        # (SURVEY.md §7 stage 6); failing loudly beats silently stabilising
-        # an empty dependency set
-        invariants.check_state(
-            self.route.is_key_domain,
-            "CollectDeps for range-domain txns requires range txn support")
         self.on_done = on_done
         topologies = self.node.topology.with_unsynced_epochs(
             self.route.participants(), self.txn_id.epoch, self.before.epoch)
@@ -340,9 +334,10 @@ class CollectDeps(Callback):
             scope = TxnRequest.compute_scope(to, topologies, self.route)
             if scope is None:
                 continue
-            keys = scope.participant_keys()
+            participants = (scope.participant_keys() if scope.is_key_domain
+                            else scope.ranges)
             self.node.send(
-                to, GetDeps(self.txn_id, scope, keys, self.before),
+                to, GetDeps(self.txn_id, scope, participants, self.before),
                 callback=self)
 
     def on_success(self, from_id: int, reply) -> None:

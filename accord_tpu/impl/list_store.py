@@ -37,6 +37,12 @@ class ListStore(DataStore):
         self.data.setdefault(key, []).append(value)
         self.write_ts[key] = at
 
+    def keys_in(self, ranges: Ranges) -> List[Key]:
+        """Data keys present within `ranges` (range-scan support; the
+        reference's maelstrom store is a sorted TreeMap serving the same
+        query, MaelstromStore)."""
+        return sorted(k for k in self.data if ranges.contains(k))
+
     def snapshot(self) -> Dict[int, Tuple[int, ...]]:
         return {k.token: tuple(v) for k, v in self.data.items()}
 
@@ -79,6 +85,36 @@ class ListRead(Read):
 
     def __repr__(self):
         return f"ListRead({self._keys!r})"
+
+
+class ListRangeRead(Read):
+    """Range-domain read: scans every key present in the ranges at execute
+    time (the reference's range queries through the same Read port — Read.java
+    read(Seekable, ...) where the Seekable is a Range)."""
+
+    def __init__(self, ranges: Ranges):
+        self._ranges = ranges
+
+    def keys(self) -> Ranges:
+        return self._ranges
+
+    def read(self, rng, execute_at: Timestamp, store: ListStore
+             ) -> AsyncResult[Data]:
+        covered = Ranges([rng]) if not isinstance(rng, Ranges) else rng
+        return success(ListData({k: store.get(k)
+                                 for k in store.keys_in(covered)}))
+
+    def slice(self, ranges: Ranges) -> "ListRangeRead":
+        return ListRangeRead(self._ranges.slice(ranges))
+
+    def merge(self, other: "ListRangeRead") -> "ListRangeRead":
+        return ListRangeRead(self._ranges.union(other._ranges))
+
+    def __eq__(self, other):
+        return isinstance(other, ListRangeRead) and self._ranges == other._ranges
+
+    def __repr__(self):
+        return f"ListRangeRead({self._ranges!r})"
 
 
 class ListWrite(Write):

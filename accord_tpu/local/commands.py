@@ -45,20 +45,30 @@ class ApplyOutcome(enum.Enum):
 
 # ---------------------------------------------------------------- deps calc --
 
-def calculate_deps(safe_store: SafeCommandStore, txn_id: TxnId, keys: Keys,
+def calculate_deps(safe_store: SafeCommandStore, txn_id: TxnId, participants,
                    before: Timestamp) -> Deps:
-    """Dependency set for txn_id over `keys` (owned slice): every active
-    conflicting txn with id < `before` (PreAccept.calculatePartialDeps ->
-    CommandsForKey.mapReduceActive)."""
+    """Dependency set for txn_id over `participants` (Keys or Ranges, owned
+    slice): every active conflicting txn with id < `before`
+    (PreAccept.calculatePartialDeps -> CommandsForKey.mapReduceActive).
+    Key-domain conflicts land in KeyDeps; range-domain conflicts in RangeDeps
+    keyed by the overlap (reference Deps.Builder domain split)."""
+    from accord_tpu.primitives.deps import RangeDeps
     builder = KeyDeps.builder()
+    rbuilder = RangeDeps.builder()
     kinds = txn_id.kind.witnesses()
 
     def visit(key: Key, dep: TxnId):
         if dep != txn_id:
             builder.add(key, dep)
 
-    safe_store.map_reduce_active(keys, before, kinds, visit)
-    return Deps(builder.build(), None)
+    def visit_range(overlap: Ranges, dep: TxnId):
+        if dep != txn_id:
+            for r in overlap:
+                rbuilder.add(r, dep)
+
+    safe_store.map_reduce_active(participants, before, kinds, visit,
+                                 on_range_dep=visit_range)
+    return Deps(builder.build(), rbuilder.build())
 
 
 def propose_execute_at(safe_store: SafeCommandStore, txn_id: TxnId,
@@ -248,10 +258,20 @@ def commit(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
         cmd.partial_txn.keys if cmd.partial_txn is not None
         else route.participants(), execute_at)
     safe_store.register(cmd, InternalStatus.STABLE)
+    _maybe_register_range_txn(safe_store, cmd)
     initialise_waiting_on(safe_store, cmd)
     safe_store.progress_log.update(safe_store.store, txn_id, cmd)
     maybe_execute(safe_store, cmd, always_notify=True)
     return AcceptOutcome.SUCCESS
+
+
+def _maybe_register_range_txn(safe_store: SafeCommandStore, cmd: Command
+                              ) -> None:
+    """A range txn first learned of at commit/apply (Maximal paths) must still
+    enter the range-conflict index."""
+    if cmd.txn_id.is_range_domain and cmd.partial_txn is not None \
+            and cmd.txn_id not in safe_store.store.range_commands:
+        safe_store.register_range_txn(cmd, cmd.partial_txn.keys)
 
 
 def _needs_definition(cmd: Command) -> bool:
@@ -312,6 +332,7 @@ def apply(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
         cmd.stable_deps = deps
         cmd.set_status(SaveStatus.STABLE)
         safe_store.register(cmd, InternalStatus.STABLE)
+        _maybe_register_range_txn(safe_store, cmd)
         initialise_waiting_on(safe_store, cmd)
     cmd.writes = writes
     cmd.result = result

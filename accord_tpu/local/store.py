@@ -103,9 +103,21 @@ class SafeCommandStore:
         return self.store._tfk(key)
 
     def owned_keys_of(self, command: Command) -> Keys:
-        """The command's participating data keys owned by this store."""
+        """The command's participating data keys owned by this store. For
+        range-domain commands, the keys with local conflict state inside the
+        owned ranges (range txns have no enumerable key set of their own)."""
         if command.partial_txn is not None and isinstance(command.partial_txn.keys, Keys):
             return command.partial_txn.keys.slice(self.ranges)
+        if command.txn_id.is_range_domain:
+            ranges = None
+            if command.partial_txn is not None:
+                ranges = command.partial_txn.keys
+            elif command.route is not None and not command.route.is_key_domain:
+                ranges = command.route.ranges
+            if ranges is None:
+                return Keys(())
+            owned = self._owned_participants(ranges)
+            return Keys(self._owned_cfk_keys(owned))
         if command.route is not None and command.route.is_key_domain:
             return Keys([Key(k.token) for k in command.route.keys]).slice(self.ranges)
         return Keys(())
@@ -123,25 +135,62 @@ class SafeCommandStore:
             if not self.ranges.is_empty else ranges
 
     # -- conflict queries --
-    def map_reduce_active(self, keys: Keys, before: Timestamp, kinds: KindSet,
-                          fn: Callable[[Key, TxnId], None]) -> None:
-        """Per-key active-conflict scan: the deps calculation
-        (SafeCommandStore.mapReduceActive -> CommandsForKey.mapReduceActive)."""
-        owned = keys.slice(self.ranges)
-        for key in owned:
+    def _owned_participants(self, participants):
+        """Slice a Keys/Ranges selection to this store's ranges."""
+        if self.ranges.is_empty:
+            return participants
+        return participants.slice(self.ranges)
+
+    def _owned_cfk_keys(self, ranges: Ranges) -> List[Key]:
+        """Data keys with conflict state inside `ranges` (the per-key walk a
+        range txn makes over CommandsForKey, CommandsForKey.java range-txn
+        registration)."""
+        return sorted(k for k in self.store.cfks if ranges.contains(k))
+
+    def _active_range_conflict(self, txn_id: TxnId, before: Timestamp,
+                               kinds: KindSet) -> bool:
+        cmd = self.store.commands.get(txn_id)
+        if cmd is None or cmd.save_status == SaveStatus.INVALIDATED \
+                or cmd.is_truncated:
+            return False
+        return txn_id < before and txn_id.kind in kinds
+
+    def map_reduce_active(self, participants, before: Timestamp,
+                          kinds: KindSet,
+                          fn: Callable[[Key, TxnId], None],
+                          on_range_dep: Callable[[Ranges, TxnId], None] = None
+                          ) -> None:
+        """Active-conflict scan — the deps calculation
+        (SafeCommandStore.mapReduceActive -> CommandsForKey.mapReduceActive).
+
+        `participants` is Keys (key-domain txn) or Ranges (range-domain /
+        sync point). Key-domain conflicts are reported per key via `fn`;
+        range-domain conflicts via `on_range_dep(overlap_ranges, dep_id)`
+        (they become RangeDeps entries, reference Deps.Builder domain split).
+        """
+        is_range = isinstance(participants, Ranges)
+        owned = self._owned_participants(participants)
+        keys = self._owned_cfk_keys(owned) if is_range else owned
+        for key in keys:
             cfk = self.store.cfks.get(key)
             if cfk is not None:
                 cfk.map_reduce_active(before, kinds, lambda t, k=key: fn(k, t))
-        # range-domain txns intersecting these keys are conflicts too
+        # range-domain txns intersecting the participants are conflicts too
         for txn_id, ranges in self.store.range_commands.items():
-            cmd = self.store.commands.get(txn_id)
-            if cmd is None or cmd.save_status == SaveStatus.INVALIDATED \
-                    or cmd.is_truncated:
+            if not self._active_range_conflict(txn_id, before, kinds):
                 continue
-            if txn_id >= before or txn_id.kind not in kinds:
+            if is_range:
+                overlap = ranges.intersection(owned)
+            else:
+                overlap = Ranges([r for r in ranges
+                                  if any(r.contains(k) for k in owned)])
+            if overlap.is_empty:
                 continue
-            for key in owned:
-                if ranges.contains(key):
+            if on_range_dep is not None:
+                on_range_dep(overlap, txn_id)
+            else:
+                for key in (self._owned_cfk_keys(overlap) if is_range
+                            else [k for k in owned if overlap.contains(k)]):
                     fn(key, txn_id)
 
     def max_conflict(self, participants) -> Optional[Timestamp]:
@@ -161,42 +210,89 @@ class SafeCommandStore:
         return False
 
     # recovery predicates (BeginRecovery.java:104-190 via mapReduceFull)
-    def rejects_fast_path(self, txn_id: TxnId, keys: Keys) -> bool:
-        wb = lambda t: self._witnessed_by(t, txn_id)
-        for key in keys.slice(self.ranges):
+    def _participant_cfks(self, participants):
+        owned = self._owned_participants(participants)
+        keys = (self._owned_cfk_keys(owned) if isinstance(owned, Ranges)
+                else owned)
+        for key in keys:
             cfk = self.store.cfks.get(key)
-            if cfk is None:
+            if cfk is not None:
+                yield cfk
+
+    def _conflicting_range_cmds(self, txn_id: TxnId, participants):
+        """(dep_cmd, overlap Ranges) for every live range-domain command whose
+        registered ranges intersect `participants`, excluding txn_id itself."""
+        owned = self._owned_participants(participants)
+        is_range = isinstance(owned, Ranges)
+        for dep_id, ranges in self.store.range_commands.items():
+            if dep_id == txn_id:
                 continue
+            cmd = self.store.commands.get(dep_id)
+            if cmd is None:
+                continue
+            if is_range:
+                overlap = ranges.intersection(owned)
+            else:
+                overlap = Ranges([r for r in ranges
+                                  if any(r.contains(k) for k in owned)])
+            if not overlap.is_empty:
+                yield cmd, overlap
+
+    def rejects_fast_path(self, txn_id: TxnId, participants) -> bool:
+        wb = lambda t: self._witnessed_by(t, txn_id)
+        for cfk in self._participant_cfks(participants):
             if cfk.accepted_or_committed_started_after_without_witnessing(txn_id, wb):
                 return True
             if cfk.committed_executes_after_without_witnessing(txn_id, wb):
                 return True
+        for cmd, _ in self._conflicting_range_cmds(txn_id, participants):
+            if not cmd.txn_id.witnesses(txn_id) or wb(cmd.txn_id) \
+                    or cmd.is_invalidated or cmd.is_truncated:
+                continue
+            if cmd.txn_id > txn_id and cmd.has_been(SaveStatus.ACCEPTED):
+                return True
+            if cmd.has_been(SaveStatus.STABLE) and cmd.execute_at is not None \
+                    and cmd.execute_at > txn_id:
+                return True
         return False
 
-    def earlier_committed_witness(self, txn_id: TxnId, keys: Keys) -> Deps:
-        """Key-associated, so recovery can await on the dep's own shards
+    def earlier_committed_witness(self, txn_id: TxnId, participants) -> Deps:
+        """Key/range-associated, so recovery can await on the dep's own shards
         (reference returns Deps, BeginRecovery.java:344)."""
-        from accord_tpu.primitives.deps import KeyDeps
+        from accord_tpu.primitives.deps import KeyDeps, RangeDeps
         wb = lambda t: self._witnessed_by(t, txn_id)
         builder = KeyDeps.builder()
-        for key in keys.slice(self.ranges):
-            cfk = self.store.cfks.get(key)
-            if cfk is not None:
-                for t in cfk.stable_started_before_and_witnessed(txn_id, wb):
-                    builder.add(key, t)
-        return Deps(builder.build(), None)
+        rbuilder = RangeDeps.builder()
+        for cfk in self._participant_cfks(participants):
+            for t in cfk.stable_started_before_and_witnessed(txn_id, wb):
+                builder.add(cfk.key, t)
+        for cmd, overlap in self._conflicting_range_cmds(txn_id, participants):
+            if cmd.txn_id < txn_id and cmd.has_been(SaveStatus.STABLE) \
+                    and not cmd.is_invalidated and not cmd.is_truncated \
+                    and wb(cmd.txn_id):
+                for r in overlap:
+                    rbuilder.add(r, cmd.txn_id)
+        return Deps(builder.build(), rbuilder.build())
 
-    def earlier_accepted_no_witness(self, txn_id: TxnId, keys: Keys) -> Deps:
-        from accord_tpu.primitives.deps import KeyDeps
+    def earlier_accepted_no_witness(self, txn_id: TxnId, participants) -> Deps:
+        from accord_tpu.primitives.deps import KeyDeps, RangeDeps
         wb = lambda t: self._witnessed_by(t, txn_id)
         builder = KeyDeps.builder()
-        for key in keys.slice(self.ranges):
-            cfk = self.store.cfks.get(key)
-            if cfk is not None:
-                for t in cfk.accepted_started_before_without_witnessing(
-                        txn_id, wb):
-                    builder.add(key, t)
-        return Deps(builder.build(), None)
+        rbuilder = RangeDeps.builder()
+        for cfk in self._participant_cfks(participants):
+            for t in cfk.accepted_started_before_without_witnessing(
+                    txn_id, wb):
+                builder.add(cfk.key, t)
+        for cmd, overlap in self._conflicting_range_cmds(txn_id, participants):
+            if cmd.txn_id < txn_id \
+                    and cmd.save_status == SaveStatus.ACCEPTED \
+                    and cmd.execute_at is not None \
+                    and cmd.execute_at > txn_id \
+                    and txn_id.witnesses(cmd.txn_id) \
+                    and not wb(cmd.txn_id):
+                for r in overlap:
+                    rbuilder.add(r, cmd.txn_id)
+        return Deps(builder.build(), rbuilder.build())
 
 
 class CommandStore:

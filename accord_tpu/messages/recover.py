@@ -151,11 +151,14 @@ class BeginRecovery(TxnRequest):
             deps, cmd.partial_txn, committed_deps, cmd.writes, cmd.result,
             rejects, earlier_witness, earlier_no_witness)
 
-    def _local_keys(self, safe_store, cmd) -> Keys:
-        if cmd.partial_txn is not None and isinstance(cmd.partial_txn.keys, Keys):
+    def _local_keys(self, safe_store, cmd):
+        """Participants (Keys or Ranges) for deps calc + decipher predicates."""
+        if cmd.partial_txn is not None:
             return cmd.partial_txn.keys
-        if self.partial_txn is not None and isinstance(self.partial_txn.keys, Keys):
+        if self.partial_txn is not None:
             return self.partial_txn.keys
+        if not self.scope.is_key_domain:
+            return self.scope.ranges
         return self.scope.participant_keys()
 
     def reduce(self, a: Reply, b: Reply) -> Reply:

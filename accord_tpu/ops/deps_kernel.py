@@ -54,8 +54,16 @@ def in_batch_graph(txn_rank: jax.Array, txn_witness_mask: jax.Array,
     shared = jnp.dot(touches.astype(jnp.float32),
                      touches.astype(jnp.float32).T,
                      preferred_element_type=jnp.float32) > 0    # [B, B] MXU
+    return conflict_edges(shared, txn_rank, txn_witness_mask, txn_kind)
+
+
+def conflict_edges(shared: jax.Array, txn_rank: jax.Array,
+                   txn_witness_mask: jax.Array, txn_kind: jax.Array):
+    """Mask a key-sharing matrix down to directed conflict edges: b' earlier
+    than b, b's kind witnesses b', both rows valid. Shared by the single-chip
+    path above and the mesh-sharded step (sharded.make_sharded_step), whose
+    `shared` term is a psum of per-shard matmuls."""
     earlier = txn_rank[None, :] < txn_rank[:, None]
     witnessed = ((txn_witness_mask[:, None] >> txn_kind[None, :]) & 1) == 1
     valid = (txn_rank >= 0)
-    dep = shared & earlier & witnessed & valid[None, :] & valid[:, None]
-    return dep
+    return shared & earlier & witnessed & valid[None, :] & valid[:, None]

@@ -30,7 +30,8 @@ shard_map = jax.shard_map
 from accord_tpu.local.cfk import CommandsForKey
 from accord_tpu.ops.encode import (BatchEncoder, STATUS_INACTIVE, _pad_to,
                                    witness_mask)
-from accord_tpu.ops.deps_kernel import batched_active_deps, in_batch_graph
+from accord_tpu.ops.deps_kernel import (batched_active_deps, conflict_edges,
+                                        in_batch_graph)
 from accord_tpu.ops.wavefront import execution_waves
 from accord_tpu.primitives.keys import Key
 from accord_tpu.primitives.timestamp import TxnId
@@ -67,10 +68,7 @@ def make_sharded_step(mesh: Mesh, axis: str = "shard"):
         tf = touches.astype(jnp.float32)
         shared = jax.lax.psum(
             jnp.dot(tf, tf.T, preferred_element_type=jnp.float32), axis) > 0
-        earlier = txn_rank[None, :] < txn_rank[:, None]
-        witnessed = ((txn_witness_mask[:, None] >> txn_kind[None, :]) & 1) == 1
-        valid = txn_rank >= 0
-        dep_bb = shared & earlier & witnessed & valid[None, :] & valid[:, None]
+        dep_bb = conflict_edges(shared, txn_rank, txn_witness_mask, txn_kind)
         waves = execution_waves(dep_bb)
         return dep_mask[None], dep_count, dep_bb, waves
 

@@ -205,18 +205,22 @@ KeyDeps.NONE = KeyDeps(Keys(()), (), ())
 class RangeDeps:
     """Range -> [TxnId] CSR multimap; ranges may overlap (RangeDeps.java:63-120).
 
-    Stabbing queries (which ranges cover key X) go through a sorted scan here;
-    the CINTIA checkpoint-interval index (reference SearchableRangeList.java:79)
-    is provided for the device tier in accord_tpu.ops.interval_index.
+    Stabbing queries (which ranges cover key X) go through the CINTIA
+    checkpoint-interval index (reference SearchableRangeList.java:79,
+    CheckpointIntervalArray.java:28-84), built lazily on first query once the
+    range count justifies it; small sets use a direct sorted scan.
     """
 
-    __slots__ = ("ranges", "txn_ids", "ranges_to_txn_ids")
+    __slots__ = ("ranges", "txn_ids", "ranges_to_txn_ids", "_index")
+
+    INDEX_THRESHOLD = 16
 
     def __init__(self, ranges: Tuple[Range, ...], txn_ids: Tuple[TxnId, ...],
                  ranges_to_txn_ids: Tuple[int, ...]):
         self.ranges = ranges            # sorted by (start, end); may overlap
         self.txn_ids = txn_ids          # sorted unique
         self.ranges_to_txn_ids = ranges_to_txn_ids
+        self._index = None              # lazy CheckpointIntervalIndex
 
     NONE: "RangeDeps"
 
@@ -268,30 +272,48 @@ class RangeDeps:
         s, e = self._span(i)
         return [self.txn_ids[self.ranges_to_txn_ids[j]] for j in range(s, e)]
 
+    def _stab_index(self):
+        if self._index is None and len(self.ranges) >= self.INDEX_THRESHOLD:
+            from accord_tpu.utils.checkpoint_intervals import \
+                CheckpointIntervalIndex
+            self._index = CheckpointIntervalIndex(
+                [r.start for r in self.ranges], [r.end for r in self.ranges])
+        return self._index
+
+    def _emit(self, i: int, seen: Set[TxnId], fn: Callable[[TxnId], None]
+              ) -> None:
+        for t in self.txn_ids_for_range_idx(i):
+            if t not in seen:
+                seen.add(t)
+                fn(t)
+
     def for_each_covering(self, key: RoutingKey, fn: Callable[[TxnId], None],
                           dedup: Optional[Set[TxnId]] = None) -> None:
         """Visit txn ids of every range containing `key`, once each."""
         seen = dedup if dedup is not None else set()
+        index = self._stab_index()
+        if index is not None:
+            index.find(key.token, lambda i: self._emit(i, seen, fn))
+            return
         for i, r in enumerate(self.ranges):
             if r.start > key.token:
                 break
             if r.contains(key):
-                for t in self.txn_ids_for_range_idx(i):
-                    if t not in seen:
-                        seen.add(t)
-                        fn(t)
+                self._emit(i, seen, fn)
 
     def for_each_intersecting(self, rng: Range, fn: Callable[[TxnId], None],
                               dedup: Optional[Set[TxnId]] = None) -> None:
         seen = dedup if dedup is not None else set()
+        index = self._stab_index()
+        if index is not None:
+            index.find_overlaps(rng.start, rng.end,
+                                lambda i: self._emit(i, seen, fn))
+            return
         for i, r in enumerate(self.ranges):
             if r.start >= rng.end:
                 break
             if r.intersects(rng):
-                for t in self.txn_ids_for_range_idx(i):
-                    if t not in seen:
-                        seen.add(t)
-                        fn(t)
+                self._emit(i, seen, fn)
 
     def for_each_unique_txn_id(self, fn: Callable[[TxnId], None]) -> None:
         for t in self.txn_ids:

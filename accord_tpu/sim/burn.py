@@ -15,8 +15,9 @@ import argparse
 import sys
 from typing import Dict, List, Optional, Tuple
 
-from accord_tpu.impl.list_store import ListQuery, ListRead, ListResult, ListUpdate
-from accord_tpu.primitives.keys import Key, Keys
+from accord_tpu.impl.list_store import (ListQuery, ListRangeRead, ListRead,
+                                        ListResult, ListUpdate)
+from accord_tpu.primitives.keys import Key, Keys, Ranges
 from accord_tpu.primitives.timestamp import TxnKind
 from accord_tpu.primitives.txn import Txn
 from accord_tpu.sim.cluster import SimCluster
@@ -41,7 +42,8 @@ class BurnRun:
     def __init__(self, seed: int, ops: int, nodes: int = 3, keys: int = 20,
                  drop_prob: float = 0.0, rf: int = None, n_shards: int = 4,
                  concurrency: int = 8,
-                 progress_log_factory="default", num_command_stores: int = 1):
+                 progress_log_factory="default", num_command_stores: int = 1,
+                 range_reads: bool = True):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -60,6 +62,7 @@ class BurnRun:
                 deliver_prob=1.0 - drop_prob)
         self.keys = keys
         self.concurrency = concurrency
+        self.range_reads = range_reads
         self.verifier = StrictSerializabilityVerifier()
         self.stats = BurnStats()
         self.next_value = 0
@@ -68,6 +71,14 @@ class BurnRun:
     # ---------------------------------------------------------- workload --
     def _gen_txn(self) -> Txn:
         rng = self.rng
+        # ~1 in 8 ops: a range read over a token window (the reference burn
+        # mixes range queries into the workload, BurnTest.java:124-210)
+        if self.range_reads and rng.next_int(0, 8) == 0:
+            lo = rng.next_int(0, self.keys - 1)
+            hi = min(self.keys, lo + 1 + rng.next_int(1, max(2, self.keys // 4)))
+            ranges = Ranges.of((lo, hi))
+            return Txn(TxnKind.READ, ranges, read=ListRangeRead(ranges),
+                       query=ListQuery())
         n_read = rng.next_int(0, 3)
         n_write = rng.next_int(0, 3) if n_read else rng.next_int(1, 3)
         read_tokens = {rng.next_zipf(self.keys) for _ in range(n_read)}
@@ -112,9 +123,16 @@ class BurnRun:
                     self.stats.nacks += 1
                 elif isinstance(value, ListResult):
                     self.stats.acks += 1
+                    reads = {k.token: v for k, v in value.read_values.items()}
+                    if isinstance(txn.keys, Ranges):
+                        # a range read asserts the FULL content of the window:
+                        # absent keys are an observed empty prefix (omitting a
+                        # key with committed writes is a serializability bug)
+                        for rng in txn.keys:
+                            for token in range(rng.start, min(rng.end, self.keys)):
+                                reads.setdefault(token, ())
                     observations.append(Observation(
-                        f"txn{idx}@n{origin}",
-                        {k.token: v for k, v in value.read_values.items()},
+                        f"txn{idx}@n{origin}", reads,
                         {k.token: v for k, v in value.appends.items()},
                         start_us, end_us))
                 else:

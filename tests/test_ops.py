@@ -71,6 +71,14 @@ def test_batched_deps_matches_scalar(seed):
     assert got == want
     # padded batch rows contribute no edges
     assert int(np.asarray(dep_count)[len(batch):].sum()) == 0
+    # per-key decode (the KeyDeps-builder bridge) matches the scalar scan too
+    by_key = {c.key: c for c in cfks}
+    keyed = enc.decode_key_deps(np.asarray(dep_mask))
+    for (tid, keys), m in zip(batch, keyed):
+        for k in keys:
+            ids = []
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.append)
+            assert m.get(k, []) == sorted(ids)
 
 
 @pytest.mark.parametrize("seed", range(8))

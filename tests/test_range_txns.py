@@ -1,0 +1,191 @@
+"""Range-domain transactions end-to-end + the CINTIA stabbing index.
+
+Reference model: range txns flow through the same PreAccept/Accept/Stable/
+Apply pipeline with Ranges participants (accord/primitives/RangeDeps.java,
+accord/messages/PreAccept.java deps calc over ranges); the checkpoint-interval
+index is accord/utils/CheckpointIntervalArray.java:28-84 /
+SearchableRangeList.java:79.
+"""
+
+import pytest
+
+from accord_tpu.impl.list_store import (ListQuery, ListRangeRead, ListRead,
+                                        ListResult, ListUpdate)
+from accord_tpu.primitives.keys import Key, Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.burn import BurnRun
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.sim.network import LinkConfig
+from accord_tpu.utils.checkpoint_intervals import CheckpointIntervalIndex
+from accord_tpu.utils.random_source import RandomSource
+
+
+def rw_txn(read_tokens, appends: dict):
+    keys = Keys.of(*(set(read_tokens) | set(appends)))
+    return Txn(TxnKind.WRITE if appends else TxnKind.READ, keys,
+               read=ListRead(Keys.of(*read_tokens)) if read_tokens else None,
+               query=ListQuery(),
+               update=ListUpdate({Key(t): v for t, v in appends.items()})
+               if appends else None)
+
+
+def range_read_txn(lo, hi):
+    ranges = Ranges.of((lo, hi))
+    return Txn(TxnKind.READ, ranges, read=ListRangeRead(ranges),
+               query=ListQuery())
+
+
+def run_txn(cluster, node_id, txn):
+    result = cluster.node(node_id).coordinate(txn)
+    ok = cluster.process_until(lambda: result.is_done)
+    assert ok, "txn did not complete"
+    return result.value()
+
+
+class TestRangeReads:
+    def test_range_read_sees_committed_writes(self):
+        cluster = SimCluster(n_nodes=3, seed=11, n_shards=4)
+        run_txn(cluster, 1, rw_txn([], {10: 1}))
+        run_txn(cluster, 2, rw_txn([], {20: 2}))
+        run_txn(cluster, 3, rw_txn([], {700: 3}))  # outside the window
+        r = run_txn(cluster, 1, range_read_txn(0, 100))
+        assert isinstance(r, ListResult)
+        assert r.read_values == {Key(10): (1,), Key(20): (2,)}
+
+    def test_range_read_cross_shard(self):
+        cluster = SimCluster(n_nodes=3, seed=12, n_shards=4)
+        # token_span=1000, 4 shards of 250: keys on three different shards
+        for t, v in [(10, 1), (300, 2), (900, 3)]:
+            run_txn(cluster, 1 + t % 3, rw_txn([], {t: v}))
+        r = run_txn(cluster, 2, range_read_txn(0, 1000))
+        assert r.read_values == {Key(10): (1,), Key(300): (2,), Key(900): (3,)}
+
+    def test_write_after_range_read_is_ordered(self):
+        """A write submitted after a range read commits must not appear in it,
+        and the read must not lose earlier writes (strict serializability
+        across domains)."""
+        cluster = SimCluster(n_nodes=3, seed=13, n_shards=2)
+        run_txn(cluster, 1, rw_txn([], {5: 0}))
+        r = run_txn(cluster, 2, range_read_txn(0, 50))
+        assert r.read_values == {Key(5): (0,)}
+        run_txn(cluster, 3, rw_txn([], {5: 1}))
+        r2 = run_txn(cluster, 1, range_read_txn(0, 50))
+        assert r2.read_values == {Key(5): (0, 1)}
+
+    def test_interleaved_range_reads_and_writes_pipelined(self):
+        """Concurrent range reads + key writes: every range read must observe
+        a prefix-closed, monotonically growing view."""
+        cluster = SimCluster(n_nodes=3, seed=14, n_shards=2)
+        results = []
+        for v in range(6):
+            w = cluster.node(1 + v % 3).coordinate(rw_txn([], {7: v}))
+            r = cluster.node(1 + (v + 1) % 3).coordinate(range_read_txn(0, 20))
+            results.append((w, r))
+        ok = cluster.process_until(
+            lambda: all(w.is_done and r.is_done for w, r in results))
+        assert ok
+        cluster.process_all()  # let trailing Applies land
+        # concurrent writes commit in *executeAt* order, not submission
+        # order; the guarantee is every read observes a prefix of the final
+        # agreed history
+        final = cluster.node(1).data_store.get(Key(7))
+        assert sorted(final) == list(range(6))
+        for _, r in results:
+            if r.failure() is not None:
+                continue
+            vals = r.value().read_values.get(Key(7), ())
+            assert vals == final[:len(vals)], \
+                f"non-prefix range read: {vals} vs final {final}"
+
+    def test_range_deps_pick_up_key_txns(self):
+        """At the metadata level: a range txn's deps include conflicting
+        key-domain txns, and later key txns depend on the range txn."""
+        cluster = SimCluster(n_nodes=3, seed=15, n_shards=1)
+        run_txn(cluster, 1, rw_txn([], {10: 1}))
+        r = run_txn(cluster, 1, range_read_txn(0, 100))
+        node = cluster.node(1)
+        store = node.command_stores.all()[0]
+        range_cmds = [c for t, c in store.commands.items()
+                      if t.is_range_domain]
+        assert range_cmds, "range txn not recorded"
+        rc = range_cmds[0]
+        key_dep_ids = set(rc.stable_deps.sorted_txn_ids())
+        assert key_dep_ids, "range txn recorded no deps on the key write"
+        # and a subsequent overlapping write records the range txn as dep
+        run_txn(cluster, 1, rw_txn([], {10: 2}))
+        w2 = [c for t, c in store.commands.items()
+              if not t.is_range_domain and c.stable_deps is not None
+              and c.stable_deps.range_deps.contains(rc.txn_id)]
+        assert w2, "later key write did not record the range txn dep"
+
+
+class TestRangeBurn:
+    @pytest.mark.parametrize("seed", [100, 101])
+    def test_burn_with_range_reads(self, seed):
+        run = BurnRun(seed, ops=120, nodes=3, keys=16, n_shards=4)
+        stats = run.run()
+        assert stats.acks > 0
+
+    def test_burn_with_range_reads_and_drops(self):
+        run = BurnRun(102, ops=100, nodes=3, keys=12, n_shards=2,
+                      drop_prob=0.05)
+        stats = run.run()
+        assert stats.acks > 0
+
+
+class TestCheckpointIntervalIndex:
+    def test_exhaustive_small(self):
+        rng = RandomSource(7)
+        for trial in range(50):
+            n = 1 + rng.next_int(40)
+            ivs = sorted(
+                (rng.next_int(100), ) for _ in range(n))
+            starts = [s for (s,) in ivs]
+            ends = [s + 1 + rng.next_int(30) for s in starts]
+            idx = CheckpointIntervalIndex(starts, ends, every=4)
+            for point in range(-1, 135):
+                got = []
+                idx.find(point, got.append)
+                assert got == CheckpointIntervalIndex.brute(
+                    starts, ends, point), (trial, point, starts, ends)
+
+    def test_overlaps_matches_brute(self):
+        rng = RandomSource(8)
+        for trial in range(30):
+            n = 1 + rng.next_int(60)
+            starts = sorted(rng.next_int(200) for _ in range(n))
+            ends = [s + 1 + rng.next_int(50) for s in starts]
+            idx = CheckpointIntervalIndex(starts, ends, every=8)
+            for _ in range(20):
+                lo = rng.next_int(220)
+                hi = lo + 1 + rng.next_int(60)
+                got = []
+                idx.find_overlaps(lo, hi, got.append)
+                want = [i for i in range(n)
+                        if starts[i] < hi and ends[i] > lo]
+                assert sorted(got) == want, (trial, lo, hi)
+                assert len(got) == len(set(got)), "duplicate emission"
+
+    def test_rangedeps_uses_index_consistently(self):
+        from accord_tpu.primitives.deps import RangeDeps
+        from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+        rng = RandomSource(9)
+        b = RangeDeps.builder()
+        ids = []
+        for i in range(60):
+            t = TxnId.create(1, 1000 + i, TxnKind.READ, Domain.RANGE, 1)
+            ids.append(t)
+            lo = rng.next_int(500)
+            b.add(Range(lo, lo + 1 + rng.next_int(100)), t)
+        rd = b.build()
+        assert rd._stab_index() is not None  # large enough to build the index
+        for token in range(0, 600, 7):
+            got = []
+            rd.for_each_covering(Key(token), got.append)
+            want = set()
+            for i, r in enumerate(rd.ranges):
+                if r.contains(Key(token)):
+                    want.update(rd.txn_ids_for_range_idx(i))
+            assert set(got) == want
+            assert len(got) == len(set(got))
