@@ -1,0 +1,151 @@
+"""CoordinateEphemeralRead: deps quorum + tracked read, one round each.
+
+Reference: the ephemeral-read coordination over GET_EPHEMERAL_READ_DEPS_REQ /
+READ_EPHEMERAL_REQ (accord/coordinate — the CoordinationAdapter ephemeral
+path; GetEphemeralReadDeps.java, which loops the deps round until the
+replica-reported latest epoch stops advancing). The read is never witnessed:
+no recovery, no progress-log entry; a failed round simply retries another
+replica or reports Timeout/Exhausted to the client.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from accord_tpu.coordinate.errors import Exhausted, Timeout
+from accord_tpu.coordinate.tracking import (QuorumTracker, ReadTracker,
+                                            RequestStatus)
+from accord_tpu.messages.base import Callback, TxnRequest
+from accord_tpu.messages.ephemeral import (GetEphemeralReadDeps,
+                                           GetEphemeralReadDepsOk,
+                                           ReadEphemeralTxnData)
+from accord_tpu.messages.read import ReadNack, ReadOk
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import TxnId
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.utils.async_chains import AsyncResult
+
+
+class CoordinateEphemeralRead(Callback):
+    def __init__(self, node, txn_id: TxnId, txn: Txn, result: AsyncResult):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = node.compute_route(txn)
+        self.result = result
+        self.epoch = txn_id.epoch
+        self.deps_tracker: Optional[QuorumTracker] = None
+        self.read_tracker: Optional[ReadTracker] = None
+        self.read_topologies: Optional[Topologies] = None
+        self.deps_oks: Dict[int, GetEphemeralReadDepsOk] = {}
+        self.read_sent: Set[int] = set()
+        self.deps: Deps = Deps.NONE
+        self.data = None
+        self.reading = False
+        self.done = False
+
+    # ------------------------------------------------------- deps round --
+    def start(self) -> None:
+        self.deps_oks.clear()
+        topologies = self.node.topology.with_unsynced_epochs(
+            self.route.participants(), self.txn_id.epoch, self.epoch)
+        self.deps_tracker = QuorumTracker(topologies)
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, self.route)
+            if scope is None:
+                continue
+            keys = self.txn.keys.slice(scope.covering())
+            self.node.send(to, GetEphemeralReadDeps(self.txn_id, scope, keys),
+                           callback=self)
+
+    def _on_deps_quorum(self) -> None:
+        self.deps = Deps.merge([ok.deps for ok in self.deps_oks.values()])
+        latest = max(ok.latest_epoch for ok in self.deps_oks.values())
+        if latest > self.epoch:
+            # replicas have advanced: redo the deps round so the quorum also
+            # intersects the newer topology (the reference loops until the
+            # reported epoch stabilises)
+            self.epoch = latest
+            self.node.with_epoch(latest, self.start)
+            return
+        self._start_read()
+
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        if isinstance(reply, GetEphemeralReadDepsOk):
+            if self.reading:
+                return  # straggler from a completed deps round
+            self.deps_oks[from_id] = reply
+            if self.deps_tracker.record_success(from_id) == RequestStatus.SUCCESS:
+                self._on_deps_quorum()
+            return
+        if not self.reading:
+            return
+        if isinstance(reply, ReadNack):
+            self._retry_read(from_id)
+            return
+        if isinstance(reply, ReadOk):
+            if reply.data is not None:
+                self.data = (reply.data if self.data is None
+                             else self.data.merge(reply.data))
+            if self.read_tracker.record_read_success(from_id) \
+                    == RequestStatus.SUCCESS:
+                self.done = True
+                self.result.try_success(
+                    self.txn.result(self.txn_id, self.txn_id, self.data))
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        if self.reading:
+            # only failures of reads we actually sent may feed the read
+            # tracker; a late deps-round timeout must not mark a healthy,
+            # never-contacted replica as a failed reader
+            if from_id in self.read_sent:
+                self._retry_read(from_id)
+            return
+        if self.deps_tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self.done = True
+            self.result.try_failure(
+                failure if isinstance(failure, Timeout)
+                else Exhausted(repr(failure)))
+
+    # ------------------------------------------------------- read round --
+    def _start_read(self) -> None:
+        self.reading = True
+        selected = self.node.topology.current().for_selection(
+            self.route.participants())
+        self.read_topologies = Topologies([selected])
+        self.read_tracker = ReadTracker(self.read_topologies)
+        prefer = [self.node.id] + sorted(selected.nodes())
+        for to in self.read_tracker.initial_contacts(prefer):
+            self._send_read(to)
+
+    def _send_read(self, to: int) -> None:
+        scope = TxnRequest.compute_scope(to, self.read_topologies, self.route)
+        if scope is None:
+            # tracker and scope derive from the same snapshot, so this should
+            # be unreachable; treat defensively as a failed read rather than
+            # leaving the tracker waiting forever
+            self._retry_read(to)
+            return
+        self.read_sent.add(to)
+        owned = scope.covering()
+        self.node.send(
+            to, ReadEphemeralTxnData(
+                self.txn_id, scope, self.txn.keys.slice(owned),
+                self.txn.slice(owned, include_query=True),
+                self.deps.slice(owned), self.epoch),
+            callback=self)
+
+    def _retry_read(self, from_id: int) -> None:
+        status, retry = self.read_tracker.record_read_failure(from_id)
+        if status == RequestStatus.FAILED:
+            self.done = True
+            self.result.try_failure(Exhausted("ephemeral read exhausted"))
+            return
+        for to in retry:
+            self._send_read(to)

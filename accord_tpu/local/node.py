@@ -167,11 +167,18 @@ class Node:
                    ) -> AsyncResult:
         """Client entry: coordinate a transaction to its Result
         (Node.coordinate :567-596)."""
+        from accord_tpu.coordinate.ephemeral import CoordinateEphemeralRead
         from accord_tpu.coordinate.transaction import CoordinateTransaction
         domain = Domain.KEY if isinstance(txn.keys, Keys) else Domain.RANGE
         if txn_id is None:
             txn_id = self.next_txn_id(txn.kind, domain)
         result = AsyncResult()
+        if txn.kind == TxnKind.EPHEMERAL_READ:
+            # invisible single-round read: no recovery registration
+            self.with_epoch(txn_id.epoch,
+                            lambda: CoordinateEphemeralRead(
+                                self, txn_id, txn, result).start())
+            return result
         self.coordinating[txn_id] = result
         result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
         self.with_epoch(txn_id.epoch,

@@ -90,6 +90,11 @@ class BurnRun:
         all_tokens = read_tokens | write_tokens
         # RMWs read what they write (the strongest check)
         read_set = read_tokens | (write_tokens if rng.next_bool() else set())
+        if not appends and len(read_set) == 1:
+            # single-key pure reads go the ephemeral (single-round, invisible)
+            # path, as the reference burn does (BurnTest.java:124-210)
+            return Txn(TxnKind.EPHEMERAL_READ, Keys.of(*read_set),
+                       read=ListRead(Keys.of(*read_set)), query=ListQuery())
         return Txn(
             TxnKind.WRITE if appends else TxnKind.READ,
             Keys.of(*all_tokens),

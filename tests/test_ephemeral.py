@@ -1,0 +1,89 @@
+"""Ephemeral reads: single-round invisible reads.
+
+Reference model: GetEphemeralReadDeps.java + ReadData's ReadEphemeralTxnData —
+the read collects write deps at a quorum, waits for them to apply at the read
+replica, and never becomes a Command anywhere.
+"""
+
+import pytest
+
+from accord_tpu.impl.list_store import ListQuery, ListRead, ListResult, ListUpdate
+from accord_tpu.primitives.keys import Key, Keys
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.burn import BurnRun
+from accord_tpu.sim.cluster import SimCluster
+
+
+def write_txn(appends: dict):
+    return Txn(TxnKind.WRITE, Keys.of(*appends), query=ListQuery(),
+               update=ListUpdate({Key(t): v for t, v in appends.items()}))
+
+
+def eph_read(token):
+    return Txn(TxnKind.EPHEMERAL_READ, Keys.of(token),
+               read=ListRead(Keys.of(token)), query=ListQuery())
+
+
+def run_txn(cluster, node_id, txn):
+    result = cluster.node(node_id).coordinate(txn)
+    ok = cluster.process_until(lambda: result.is_done)
+    assert ok, "txn did not complete"
+    return result.value()
+
+
+class TestEphemeralRead:
+    def test_reads_committed_writes(self):
+        cluster = SimCluster(n_nodes=3, seed=21, n_shards=2)
+        run_txn(cluster, 1, write_txn({5: 1}))
+        run_txn(cluster, 2, write_txn({5: 2}))
+        r = run_txn(cluster, 3, eph_read(5))
+        assert isinstance(r, ListResult)
+        assert r.read_values[Key(5)] == (1, 2)
+
+    def test_never_becomes_a_command(self):
+        cluster = SimCluster(n_nodes=3, seed=22)
+        run_txn(cluster, 1, write_txn({9: 1}))
+        run_txn(cluster, 1, eph_read(9))
+        cluster.process_all()
+        for node in cluster.nodes.values():
+            for store in node.command_stores.all():
+                for txn_id in store.commands:
+                    assert txn_id.kind != TxnKind.EPHEMERAL_READ
+                for cfk in store.cfks.values():
+                    for t in cfk.all_ids():
+                        assert t.kind != TxnKind.EPHEMERAL_READ
+
+    def test_waits_for_inflight_write(self):
+        """An ephemeral read that collects a not-yet-applied write as a dep
+        must observe it (prefix includes every dep it witnessed)."""
+        cluster = SimCluster(n_nodes=3, seed=23)
+        results = []
+        for v in range(8):
+            w = cluster.node(1 + v % 3).coordinate(write_txn({4: v}))
+            r = cluster.node(1 + (v + 1) % 3).coordinate(eph_read(4))
+            results.append((w, r))
+        ok = cluster.process_until(
+            lambda: all(w.is_done and r.is_done for w, r in results))
+        assert ok
+        cluster.process_all()
+        final = cluster.node(1).data_store.get(Key(4))
+        assert sorted(final) == list(range(8))
+        for _, r in results:
+            if r.failure() is not None:
+                continue
+            vals = r.value().read_values.get(Key(4), ())
+            assert vals == final[:len(vals)], \
+                f"non-prefix ephemeral read: {vals} vs {final}"
+
+    @pytest.mark.parametrize("seed", [300, 301])
+    def test_burn_with_ephemeral_reads(self, seed):
+        run = BurnRun(seed, ops=120, nodes=3, keys=12, n_shards=2)
+        stats = run.run()
+        assert stats.acks > 0
+
+    def test_burn_ephemeral_with_drops(self):
+        run = BurnRun(302, ops=100, nodes=3, keys=10, n_shards=2,
+                      drop_prob=0.05)
+        stats = run.run()
+        assert stats.acks > 0
