@@ -10,12 +10,12 @@ replica or reports Timeout/Exhausted to the client.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional
 
 from accord_tpu.coordinate.errors import Exhausted, Timeout
 from accord_tpu.coordinate.tracking import (QuorumTracker, ReadTracker,
                                             RequestStatus)
-from accord_tpu.messages.base import Callback, TxnRequest
+from accord_tpu.messages.base import Callback, RoundCallback, TxnRequest
 from accord_tpu.messages.ephemeral import (GetEphemeralReadDeps,
                                            GetEphemeralReadDepsOk,
                                            ReadEphemeralTxnData)
@@ -28,7 +28,7 @@ from accord_tpu.topology.topologies import Topologies
 from accord_tpu.utils.async_chains import AsyncResult
 
 
-class CoordinateEphemeralRead(Callback):
+class CoordinateEphemeralRead:
     def __init__(self, node, txn_id: TxnId, txn: Txn, result: AsyncResult):
         self.node = node
         self.txn_id = txn_id
@@ -40,7 +40,7 @@ class CoordinateEphemeralRead(Callback):
         self.read_tracker: Optional[ReadTracker] = None
         self.read_topologies: Optional[Topologies] = None
         self.deps_oks: Dict[int, GetEphemeralReadDepsOk] = {}
-        self.read_sent: Set[int] = set()
+        self.generation = 0  # bumped per round; stragglers are discarded
         self.deps: Deps = Deps.NONE
         self.data = None
         self.reading = False
@@ -49,6 +49,8 @@ class CoordinateEphemeralRead(Callback):
     # ------------------------------------------------------- deps round --
     def start(self) -> None:
         self.deps_oks.clear()
+        self.generation += 1
+        cb = RoundCallback(self, ("deps", self.generation))
         topologies = self.node.topology.with_unsynced_epochs(
             self.route.participants(), self.txn_id.epoch, self.epoch)
         self.deps_tracker = QuorumTracker(topologies)
@@ -58,7 +60,7 @@ class CoordinateEphemeralRead(Callback):
                 continue
             keys = self.txn.keys.slice(scope.covering())
             self.node.send(to, GetEphemeralReadDeps(self.txn_id, scope, keys),
-                           callback=self)
+                           callback=cb)
 
     def _on_deps_quorum(self) -> None:
         self.deps = Deps.merge([ok.deps for ok in self.deps_oks.values()])
@@ -72,17 +74,20 @@ class CoordinateEphemeralRead(Callback):
             return
         self._start_read()
 
-    def on_success(self, from_id: int, reply) -> None:
-        if self.done:
-            return
-        if isinstance(reply, GetEphemeralReadDepsOk):
-            if self.reading:
-                return  # straggler from a completed deps round
+    def _is_current(self, round_id) -> bool:
+        phase, gen = round_id
+        if gen != self.generation:
+            return False
+        return phase == ("read" if self.reading else "deps")
+
+    def on_round_success(self, round_id, from_id: int, reply) -> None:
+        if self.done or not self._is_current(round_id):
+            return  # straggler from a superseded round
+        if not self.reading:
+            assert isinstance(reply, GetEphemeralReadDepsOk)
             self.deps_oks[from_id] = reply
             if self.deps_tracker.record_success(from_id) == RequestStatus.SUCCESS:
                 self._on_deps_quorum()
-            return
-        if not self.reading:
             return
         if isinstance(reply, ReadNack):
             self._retry_read(from_id)
@@ -97,15 +102,12 @@ class CoordinateEphemeralRead(Callback):
                 self.result.try_success(
                     self.txn.result(self.txn_id, self.txn_id, self.data))
 
-    def on_failure(self, from_id: int, failure: BaseException) -> None:
-        if self.done:
+    def on_round_failure(self, round_id, from_id: int,
+                         failure: BaseException) -> None:
+        if self.done or not self._is_current(round_id):
             return
         if self.reading:
-            # only failures of reads we actually sent may feed the read
-            # tracker; a late deps-round timeout must not mark a healthy,
-            # never-contacted replica as a failed reader
-            if from_id in self.read_sent:
-                self._retry_read(from_id)
+            self._retry_read(from_id)
             return
         if self.deps_tracker.record_failure(from_id) == RequestStatus.FAILED:
             self.done = True
@@ -116,6 +118,7 @@ class CoordinateEphemeralRead(Callback):
     # ------------------------------------------------------- read round --
     def _start_read(self) -> None:
         self.reading = True
+        self.generation += 1
         selected = self.node.topology.current().for_selection(
             self.route.participants())
         self.read_topologies = Topologies([selected])
@@ -132,14 +135,13 @@ class CoordinateEphemeralRead(Callback):
             # leaving the tracker waiting forever
             self._retry_read(to)
             return
-        self.read_sent.add(to)
         owned = scope.covering()
         self.node.send(
             to, ReadEphemeralTxnData(
                 self.txn_id, scope, self.txn.keys.slice(owned),
                 self.txn.slice(owned, include_query=True),
                 self.deps.slice(owned), self.epoch),
-            callback=self)
+            callback=RoundCallback(self, ("read", self.generation)))
 
     def _retry_read(self, from_id: int) -> None:
         status, retry = self.read_tracker.record_read_failure(from_id)

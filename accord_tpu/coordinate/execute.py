@@ -14,8 +14,8 @@ from typing import Dict, List, Optional
 from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Timeout
 from accord_tpu.coordinate.tracking import QuorumTracker, ReadTracker, RequestStatus
 from accord_tpu.messages.accept import Accept, AcceptNack, AcceptOk
-from accord_tpu.messages.apply_msg import Apply, ApplyKind
-from accord_tpu.messages.base import Callback, TxnRequest
+from accord_tpu.messages.apply_msg import Apply, ApplyKind, ApplyReply
+from accord_tpu.messages.base import Callback, RoundCallback, TxnRequest
 from accord_tpu.messages.commit import Commit, CommitKind
 from accord_tpu.messages.read import ReadNack, ReadOk, ReadTxnData
 from accord_tpu.primitives.deps import Deps
@@ -94,7 +94,8 @@ class ExecutePath(Callback):
 
     def __init__(self, node, txn_id: TxnId, txn: Txn, route: Route,
                  execute_at: Timestamp, deps: Deps, commit_kind: CommitKind,
-                 apply_kind: ApplyKind, result: AsyncResult):
+                 apply_kind: ApplyKind, result: AsyncResult,
+                 applied_result: Optional[AsyncResult] = None):
         self.node = node
         self.txn_id = txn_id
         self.txn = txn
@@ -104,6 +105,10 @@ class ExecutePath(Callback):
         self.commit_kind = commit_kind
         self.apply_kind = apply_kind
         self.result = result
+        # non-None: additionally track Apply acks to a quorum per shard and
+        # fire this result (ExecuteSyncPoint semantics / AppliedTracker)
+        self.applied_result = applied_result
+        self.applied_tracker: Optional[QuorumTracker] = None
         self.stable_tracker: Optional[QuorumTracker] = None
         self.read_tracker: Optional[ReadTracker] = None
         self.read_nodes: List[int] = []
@@ -142,6 +147,21 @@ class ExecutePath(Callback):
                            self.execute_at, self.deps, read_keys=to_read,
                            full_route=self.route),
                 callback=self)
+
+    # -- apply acks arrive on their own round (RoundCallback "apply"), so a
+    # late stable/read timeout can never be mis-credited to the apply quorum --
+    def on_round_success(self, round_id, from_id: int, reply) -> None:
+        if isinstance(reply, ApplyReply):
+            self._on_apply_reply(from_id, reply)
+
+    def on_round_failure(self, round_id, from_id: int,
+                         failure: BaseException) -> None:
+        if self.applied_result is None or self.applied_result.is_done:
+            return
+        if self.applied_tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self.applied_result.try_failure(
+                failure if isinstance(failure, Timeout)
+                else Exhausted(repr(failure)))
 
     # -- stable/read replies --
     def on_success(self, from_id: int, reply) -> None:
@@ -214,6 +234,10 @@ class ExecutePath(Callback):
         maximal = self.apply_kind == ApplyKind.MAXIMAL
         topologies = self.node.topology.with_unsynced_epochs(
             self.route.participants(), self.txn_id.epoch, self.execute_at.epoch)
+        apply_cb = None
+        if self.applied_result is not None:
+            self.applied_tracker = QuorumTracker(topologies)
+            apply_cb = RoundCallback(self, "apply")
         for to in topologies.nodes():
             scope = TxnRequest.compute_scope(to, topologies, self.route)
             if scope is None:
@@ -223,8 +247,21 @@ class ExecutePath(Callback):
             self.node.send(
                 to, Apply(self.apply_kind, self.txn_id, scope,
                           self.execute_at, self.deps, writes, result,
-                          partial_txn=partial, full_route=self.route))
+                          partial_txn=partial, full_route=self.route),
+                callback=apply_cb)
         self.result.try_success(result)
+
+    # -- apply acks (only when applied_result tracking was requested) --
+    def _on_apply_reply(self, from_id: int, reply: ApplyReply) -> None:
+        if self.applied_result is None or self.applied_result.is_done:
+            return
+        if reply.outcome == ApplyReply.INSUFFICIENT:
+            if self.applied_tracker.record_failure(from_id) == RequestStatus.FAILED:
+                self.applied_result.try_failure(
+                    Exhausted("apply quorum unreachable"))
+            return
+        if self.applied_tracker.record_success(from_id) == RequestStatus.SUCCESS:
+            self.applied_result.try_success(None)
 
     def _obsolete(self) -> None:
         """A competing coordinator persisted the outcome first; our read

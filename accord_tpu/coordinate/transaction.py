@@ -32,6 +32,10 @@ from accord_tpu.utils.async_chains import AsyncResult
 
 
 class CoordinateTransaction(Callback):
+    # exclusive sync points suppress the fast path even on a unanimous
+    # electorate (CoordinationAdapter.java:244-261); see CoordinateSyncPoint
+    permit_fast_path = True
+
     def __init__(self, node, txn_id: TxnId, txn: Txn, result: AsyncResult):
         self.node = node
         self.txn_id = txn_id
@@ -95,7 +99,7 @@ class CoordinateTransaction(Callback):
         self.done = True
         oks = list(self.oks.values())
         merged_deps = Deps.merge([ok.deps for ok in oks])
-        if self.tracker.has_fast_path_accepted:
+        if self.permit_fast_path and self.tracker.has_fast_path_accepted:
             # fast path: execute at the original timestamp
             self.node.events.on_fast_path_taken(self.txn_id)
             self._execute(CommitKind.STABLE_FAST_PATH,

@@ -191,6 +191,24 @@ class Callback:
         raise failure
 
 
+class RoundCallback(Callback):
+    """Tags replies/failures with the round they belong to, so multi-round
+    coordinators (deps->read, stable->apply) can discard stragglers from a
+    superseded round instead of mis-crediting them to the current tracker
+    (the reference pins callbacks per-message for the same reason,
+    SafeCallback.java)."""
+
+    def __init__(self, owner, round_id):
+        self.owner = owner
+        self.round_id = round_id
+
+    def on_success(self, from_id: int, reply: Reply) -> None:
+        self.owner.on_round_success(self.round_id, from_id, reply)
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        self.owner.on_round_failure(self.round_id, from_id, failure)
+
+
 class FunctionCallback(Callback):
     def __init__(self, on_success: Callable[[int, Reply], None],
                  on_failure: Callable[[int, BaseException], None] = None):

@@ -34,6 +34,53 @@ class _NotifyOnCommit(TransientListener):
             self.result.try_success(SimpleReply(SimpleReply.OK))
 
 
+class _NotifyOnApplied(TransientListener):
+    def __init__(self, result: AsyncResult):
+        self.result = result
+        self.done = False
+
+    def on_change(self, safe_store, command: Command) -> None:
+        self.maybe_fire(command)
+
+    def maybe_fire(self, command: Command) -> None:
+        if self.done:
+            return
+        if command.is_applied_or_gone or command.is_truncated:
+            self.done = True
+            command.remove_transient_listener(self)
+            self.result.try_success(SimpleReply(SimpleReply.OK))
+
+
+class WaitUntilApplied(TxnRequest):
+    """Block until the txn has applied locally, then ack
+    (accord/messages/WaitUntilApplied — WAIT_UNTIL_APPLIED_REQ). Used by
+    durability rounds to confirm a sync point's dependencies drained on this
+    replica."""
+
+    type = MessageType.WAIT_UNTIL_APPLIED_REQ
+
+    def __init__(self, txn_id: TxnId, scope: Route):
+        super().__init__(txn_id, scope)
+
+    def apply(self, safe_store):
+        command = safe_store.get(self.txn_id)
+        result: AsyncResult = AsyncResult()
+        listener = _NotifyOnApplied(result)
+        command.add_transient_listener(listener)
+        listener.maybe_fire(command)
+        if not listener.done and not command.has_been(SaveStatus.STABLE):
+            safe_store.progress_log.waiting(
+                self.txn_id, safe_store.store, "Applied", command.route,
+                self.scope.participants())
+        return result
+
+    def reduce(self, a, b):
+        return b
+
+    def __repr__(self):
+        return f"WaitUntilApplied({self.txn_id!r})"
+
+
 class WaitOnCommit(TxnRequest):
     type = MessageType.WAIT_ON_COMMIT_REQ
 

@@ -1,0 +1,163 @@
+"""Sync points and barriers.
+
+Reference model: CoordinateSyncPoint.java / ExecuteSyncPoint.java /
+Barrier.java:64-168 — deps-only pseudo-txns whose application certifies every
+earlier conflicting txn on their ranges has stably executed.
+"""
+
+import pytest
+
+from accord_tpu.coordinate.syncpoint import (BarrierType, CoordinateSyncPoint,
+                                             SyncPoint, barrier)
+from accord_tpu.impl.list_store import ListQuery, ListRead, ListUpdate
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.primitives.keys import Key, Keys, Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.sim.network import LinkConfig
+
+
+def write_txn(appends: dict):
+    return Txn(TxnKind.WRITE, Keys.of(*appends), query=ListQuery(),
+               update=ListUpdate({Key(t): v for t, v in appends.items()}))
+
+
+def run(cluster, result):
+    ok = cluster.process_until(lambda: result.is_done)
+    assert ok, "did not complete"
+    if result.failure() is not None:
+        raise result.failure()
+    return result.value()
+
+
+class TestSyncPoint:
+    @pytest.mark.parametrize("kind", [TxnKind.SYNC_POINT,
+                                      TxnKind.EXCLUSIVE_SYNC_POINT])
+    def test_coordinates_over_ranges(self, kind):
+        cluster = SimCluster(n_nodes=3, seed=31, n_shards=4)
+        run(cluster, cluster.node(1).coordinate(write_txn({10: 1})))
+        sp = run(cluster, CoordinateSyncPoint.coordinate(
+            cluster.node(2), kind, Ranges.of((0, 500))))
+        assert isinstance(sp, SyncPoint)
+        assert sp.txn_id.kind == kind
+        assert sp.txn_id.is_range_domain
+
+    def test_sync_point_witnesses_prior_writes(self):
+        cluster = SimCluster(n_nodes=3, seed=32, n_shards=2)
+        run(cluster, cluster.node(1).coordinate(write_txn({5: 1})))
+        run(cluster, cluster.node(1).coordinate(write_txn({400: 2})))
+        sp = run(cluster, CoordinateSyncPoint.coordinate(
+            cluster.node(3), TxnKind.EXCLUSIVE_SYNC_POINT,
+            Ranges.of((0, 1000))))
+        cluster.process_all()
+        # the sync point's stable deps at each replica include both writes
+        node = cluster.node(1)
+        found = 0
+        for store in node.command_stores.all():
+            cmd = store.commands.get(sp.txn_id)
+            if cmd is None or cmd.stable_deps is None:
+                continue
+            found += sum(1 for t in cmd.stable_deps.sorted_txn_ids()
+                         if not t.is_range_domain)
+        assert found >= 2
+
+    def test_await_applied_waits_for_deps(self):
+        """GLOBAL_SYNC: when the barrier resolves, every earlier write on its
+        ranges is applied at a quorum (here: all applies landed in-sim)."""
+        cluster = SimCluster(n_nodes=3, seed=33, n_shards=2)
+        w = cluster.node(1).coordinate(write_txn({5: 1}))
+        b = barrier(cluster.node(2), Ranges.of((0, 1000)),
+                    BarrierType.GLOBAL_SYNC)
+        sp = run(cluster, b)
+        assert isinstance(sp, SyncPoint)
+        assert w.is_done
+        # at least a quorum applied the write before the barrier resolved;
+        # in this drop-free sim the write is applied wherever it is stable
+        applied = 0
+        for node in cluster.nodes.values():
+            for store in node.command_stores.all():
+                for t, cmd in store.commands.items():
+                    if not t.is_range_domain and t.kind == TxnKind.WRITE \
+                            and cmd.has_been(SaveStatus.APPLIED):
+                        applied += 1
+        assert applied >= 2
+
+    def test_local_barrier(self):
+        cluster = SimCluster(n_nodes=3, seed=34, n_shards=2)
+        run(cluster, cluster.node(1).coordinate(write_txn({7: 1})))
+        b = barrier(cluster.node(2), Keys.of(7), BarrierType.LOCAL)
+        sp = run(cluster, b)
+        # locally applied on node 2's covering stores
+        node = cluster.node(2)
+        for store in node.command_stores.intersecting(sp.ranges):
+            cmd = store.commands.get(sp.txn_id)
+            assert cmd is not None and cmd.has_been(SaveStatus.APPLIED)
+
+    def test_global_async_barrier(self):
+        cluster = SimCluster(n_nodes=3, seed=35)
+        sp = run(cluster, barrier(cluster.node(1), Keys.of(3),
+                                  BarrierType.GLOBAL_ASYNC))
+        assert isinstance(sp, SyncPoint)
+
+    def test_sync_point_under_drops(self):
+        from accord_tpu.coordinate.errors import CoordinationFailed
+        cluster = SimCluster(n_nodes=3, seed=36, n_shards=2)
+        run(cluster, cluster.node(1).coordinate(write_txn({5: 1})))
+        cluster.network.default_link = LinkConfig(deliver_prob=0.92)
+        # a single attempt may legitimately time out under loss; the caller
+        # (durability scheduling / bootstrap) retries
+        for attempt in range(5):
+            try:
+                sp = run(cluster, CoordinateSyncPoint.coordinate(
+                    cluster.node(2), TxnKind.SYNC_POINT, Ranges.of((0, 1000)),
+                    await_applied=True))
+                break
+            except CoordinationFailed:
+                continue
+        else:
+            raise AssertionError("sync point never succeeded in 5 attempts")
+        assert isinstance(sp, SyncPoint)
+
+    def test_wait_until_applied(self):
+        """WAIT_UNTIL_APPLIED acks only after local application (the
+        durability-round primitive)."""
+        from accord_tpu.messages.base import Callback, SimpleReply
+        from accord_tpu.messages.wait import WaitUntilApplied
+
+        cluster = SimCluster(n_nodes=3, seed=38, n_shards=1)
+        sp = run(cluster, CoordinateSyncPoint.coordinate(
+            cluster.node(1), TxnKind.EXCLUSIVE_SYNC_POINT,
+            Ranges.of((0, 1000))))
+        got = []
+
+        class _C(Callback):
+            def on_success(self, from_id, reply):
+                got.append((from_id, reply))
+
+            def on_failure(self, from_id, failure):
+                raise AssertionError(failure)
+
+        node = cluster.node(1)
+        scope = sp.route.slice(Ranges.of((0, 1000)))
+        node.send(2, WaitUntilApplied(sp.txn_id, scope), callback=_C())
+        assert cluster.process_until(lambda: bool(got))
+        frm, reply = got[0]
+        assert frm == 2 and isinstance(reply, SimpleReply)
+        cmd = cluster.node(2).command_stores.all()[0].commands[sp.txn_id]
+        assert cmd.has_been(SaveStatus.APPLIED)
+
+    def test_later_txns_depend_on_exclusive_sync_point(self):
+        """ESP is witnessed by everything globally visible: later writes on
+        its ranges must record it as a dependency."""
+        cluster = SimCluster(n_nodes=3, seed=37, n_shards=1)
+        sp = run(cluster, CoordinateSyncPoint.coordinate(
+            cluster.node(1), TxnKind.EXCLUSIVE_SYNC_POINT,
+            Ranges.of((0, 1000))))
+        run(cluster, cluster.node(2).coordinate(write_txn({5: 1})))
+        cluster.process_all()
+        store = cluster.node(1).command_stores.all()[0]
+        dependents = [c for t, c in store.commands.items()
+                      if not t.is_range_domain and c.stable_deps is not None
+                      and c.stable_deps.range_deps.contains(sp.txn_id)]
+        assert dependents, "later write did not witness the ESP"
