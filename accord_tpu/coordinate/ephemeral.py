@@ -68,8 +68,12 @@ class CoordinateEphemeralRead:
         if latest > self.epoch:
             # replicas have advanced: redo the deps round so the quorum also
             # intersects the newer topology (the reference loops until the
-            # reported epoch stabilises)
+            # reported epoch stabilises). Invalidate the current round NOW —
+            # the restart may be deferred on with_epoch, and a straggler from
+            # this round re-reaching quorum would otherwise start a read
+            # round the restart then orphans
             self.epoch = latest
+            self.generation += 1
             self.node.with_epoch(latest, self.start)
             return
         self._start_read()

@@ -1,0 +1,142 @@
+"""FetchData / FindRoute / MaybeRecover: knowledge acquisition.
+
+Reference: accord/coordinate/FetchData.java (pull status/definition/deps/
+outcome for a txn by contacting its shards with CheckStatus ALL, then apply
+locally via Propagate), FindRoute.java / FindSomeRoute.java (discover the
+route of a txn known only by id), MaybeRecover.java (home-shard check: has
+anyone progressed? if yes propagate, else escalate to Recover).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from accord_tpu.coordinate.errors import Exhausted, Timeout
+from accord_tpu.coordinate.tracking import QuorumTracker, RequestStatus
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.messages.base import Callback, TxnRequest
+from accord_tpu.messages.checkstatus import (CheckStatus, CheckStatusNack,
+                                             CheckStatusOk, IncludeInfo)
+from accord_tpu.messages.propagate import Propagate
+from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import TxnId
+from accord_tpu.utils.async_chains import AsyncResult
+
+
+class _CheckShards(Callback):
+    """Quorum of CheckStatus over the route's shards, merged
+    (coordinate/CheckShards.java)."""
+
+    def __init__(self, node, txn_id: TxnId, route: Route,
+                 include_info: IncludeInfo, result: AsyncResult):
+        self.node = node
+        self.txn_id = txn_id
+        self.route = route
+        self.include_info = include_info
+        self.result = result
+        self.merged: Optional[CheckStatusOk] = None
+        self.tracker: Optional[QuorumTracker] = None
+        self.done = False
+
+    def start(self) -> None:
+        topologies = self.node.topology.with_unsynced_epochs(
+            self.route.participants(), self.txn_id.epoch,
+            max(self.txn_id.epoch, self.node.epoch))
+        self.tracker = QuorumTracker(topologies)
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, self.route)
+            if scope is None:
+                continue
+            self.node.send(to, CheckStatus(self.txn_id, scope,
+                                           self.include_info),
+                           callback=self)
+
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        if isinstance(reply, CheckStatusOk):
+            self.merged = (reply if self.merged is None
+                           else self.merged.merge(reply))
+        if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
+            self.done = True
+            self.result.try_success(self.merged)
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        if self.tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self.done = True
+            if self.merged is not None:
+                # partial knowledge beats none (FetchData tolerates < quorum)
+                self.result.try_success(self.merged)
+            else:
+                self.result.try_failure(
+                    failure if isinstance(failure, Timeout)
+                    else Exhausted(repr(failure)))
+
+
+def check_shards(node, txn_id: TxnId, route: Route,
+                 include_info: IncludeInfo) -> AsyncResult:
+    result: AsyncResult = AsyncResult()
+    _CheckShards(node, txn_id, route, include_info, result).start()
+    return result
+
+
+def fetch_data(node, txn_id: TxnId, route: Route) -> AsyncResult:
+    """Fetch the maximum available knowledge for txn_id from its shards and
+    apply it locally; resolves to the merged CheckStatusOk
+    (coordinate/FetchData.java)."""
+    result: AsyncResult = AsyncResult()
+
+    def on_checked(merged: Optional[CheckStatusOk], failure):
+        if failure is not None:
+            result.try_failure(failure)
+            return
+        if merged is not None and merged.save_status > SaveStatus.NOT_DEFINED:
+            full = merged.route if merged.route is not None else route
+            node.local_request(Propagate(txn_id, full, merged))
+        result.try_success(merged)
+
+    check_shards(node, txn_id, route, IncludeInfo.ALL).add_callback(on_checked)
+    return result
+
+
+def find_route(node, txn_id: TxnId, some_participants) -> AsyncResult:
+    """Discover a txn's route by asking the shards of whatever participants
+    we learned of it through (FindRoute/FindSomeRoute — `someUnseekables`).
+    Resolves to the merged CheckStatusOk (whose .route may still be None)."""
+    from accord_tpu.primitives.keys import Ranges, RoutingKey
+    if isinstance(some_participants, Ranges):
+        probe = Route(RoutingKey(some_participants[0].start),
+                      ranges=some_participants, is_full=False)
+    else:
+        routing = some_participants.as_routing()
+        probe = Route(routing[0], keys=routing, is_full=False)
+    return check_shards(node, txn_id, probe, IncludeInfo.ALL)
+
+
+def maybe_recover(node, txn_id: TxnId, route: Route,
+                  prev_status: SaveStatus) -> AsyncResult:
+    """Home-shard liveness check: if anyone has moved the txn past
+    `prev_status`, just absorb that knowledge; otherwise drive Recover
+    (coordinate/MaybeRecover.java)."""
+    result: AsyncResult = AsyncResult()
+
+    def on_checked(merged: Optional[CheckStatusOk], failure):
+        if failure is not None:
+            result.try_failure(failure)
+            return
+        progressed = merged is not None and (
+            merged.save_status > prev_status or merged.is_coordinating)
+        if progressed:
+            if merged.save_status > SaveStatus.NOT_DEFINED:
+                full = merged.route if merged.route is not None else route
+                node.local_request(Propagate(txn_id, full, merged))
+            result.try_success(merged)
+            return
+        node.recover(txn_id, route).add_callback(
+            lambda v, f: result.try_failure(f) if f is not None
+            else result.try_success(v))
+
+    check_shards(node, txn_id, route, IncludeInfo.ALL).add_callback(on_checked)
+    return result

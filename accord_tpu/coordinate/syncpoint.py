@@ -146,7 +146,7 @@ def barrier(node, seekables, barrier_type: BarrierType) -> AsyncResult:
 def _await_local_apply(node, sp: SyncPoint, result: AsyncResult) -> None:
     """Fire `result` with `sp` once every local store covering its ranges has
     applied it (Barrier's local listener)."""
-    from accord_tpu.local.command import TransientListener
+    from accord_tpu.local.command import OnAppliedListener
     from accord_tpu.local.store import PreLoadContext
 
     stores = node.command_stores.intersecting(sp.ranges)
@@ -155,29 +155,15 @@ def _await_local_apply(node, sp: SyncPoint, result: AsyncResult) -> None:
         return
     remaining = {s.id for s in stores}
 
-    class _L(TransientListener):
-        def __init__(self, store_id):
-            self.store_id = store_id
-            self.fired = False
-
-        def on_change(self, safe_store, command) -> None:
-            self.maybe_fire(command)
-
-        def maybe_fire(self, command) -> None:
-            if self.fired:
-                return
-            if command.is_applied_or_gone or command.is_truncated:
-                self.fired = True
-                command.remove_transient_listener(self)
-                remaining.discard(self.store_id)
-                if not remaining:
-                    result.try_success(sp)
-
     def arm(safe_store):
-        cmd = safe_store.get(sp.txn_id)
-        listener = _L(safe_store.store.id)
-        cmd.add_transient_listener(listener)
-        listener.maybe_fire(cmd)
+        store_id = safe_store.store.id
+
+        def fired(_command):
+            remaining.discard(store_id)
+            if not remaining:
+                result.try_success(sp)
+
+        OnAppliedListener.arm(safe_store.get(sp.txn_id), fired)
 
     for store in stores:
         store.execute(PreLoadContext.for_txn(sp.txn_id), arm)

@@ -42,15 +42,17 @@ class _BlockedState:
     """A local command is stuck waiting for `txn_id` to reach `blocked_until`
     (SimpleProgressLog.BlockedState)."""
 
-    __slots__ = ("txn_id", "route", "blocked_until", "since_s", "attempts")
+    __slots__ = ("txn_id", "route", "blocked_until", "since_s", "attempts",
+                 "participants")
 
     def __init__(self, txn_id: TxnId, route: Optional[Route],
-                 blocked_until: str, now_s: float):
+                 blocked_until: str, now_s: float, participants=None):
         self.txn_id = txn_id
         self.route = route
         self.blocked_until = blocked_until
         self.since_s = now_s
         self.attempts = 0
+        self.participants = participants  # keys/ranges we learned it through
 
 
 class SimpleProgressLog(ProgressLog):
@@ -95,7 +97,7 @@ class SimpleProgressLog(ProgressLog):
         cmd = self.store.commands.get(blocked_by)
         r = route if route is not None else (cmd.route if cmd else None)
         self.blocked[blocked_by] = _BlockedState(blocked_by, r, blocked_until,
-                                                 self._now_s())
+                                                 self._now_s(), participants)
 
     def durable(self, command) -> None:
         if command.durability.is_durable:
@@ -124,8 +126,12 @@ class SimpleProgressLog(ProgressLog):
             return
         state.investigating = True
         state.attempts += 1
-        self._recover(state.txn_id, state.route,
-                      lambda: self._done_home(state))
+        # first ask the home shard whether anyone progressed; only escalate
+        # to a recovery ballot if nobody did (MaybeRecover.java)
+        from accord_tpu.coordinate.fetch import maybe_recover
+        maybe_recover(self.node, state.txn_id, state.route,
+                      state.status).add_callback(
+            lambda v, f: self._done_home(state))
 
     def _done_home(self, state: _HomeState) -> None:
         state.investigating = False
@@ -140,11 +146,30 @@ class SimpleProgressLog(ProgressLog):
         if now < deadline:
             return
         route = state.route or (cmd.route if cmd is not None else None)
+        from accord_tpu.coordinate.fetch import fetch_data, find_route
         if route is None:
-            return  # no route knowledge yet; CheckStatus/FetchData territory
+            # learn the route through the participants that recorded the dep;
+            # discovery polls do not consume the cheap-fetch budget, and a
+            # learned route starts the escalation ladder from the bottom
+            state.since_s = now
+            if state.participants is None or len(state.participants) == 0:
+                return
+            def learned(merged, failure, state=state):
+                if failure is None and merged is not None \
+                        and merged.route is not None:
+                    state.route = merged.route
+                    state.attempts = 0
+            find_route(self.node, state.txn_id,
+                       state.participants).add_callback(learned)
+            return
         state.attempts += 1
         state.since_s = now
-        self._recover(state.txn_id, route, lambda: None)
+        if state.attempts <= 2:
+            # cheap path first: pull the missing commit/apply from its shards
+            fetch_data(self.node, state.txn_id, route)
+        else:
+            # still stuck: the txn itself may be undecided — recover it
+            self._recover(state.txn_id, route, lambda: None)
 
     def _recover(self, txn_id: TxnId, route: Route, on_settled) -> None:
         result = self.node.recover(txn_id, route)

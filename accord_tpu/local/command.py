@@ -88,6 +88,36 @@ class TransientListener:
         raise NotImplementedError
 
 
+class OnAppliedListener(TransientListener):
+    """Fire `on_fired(command)` once the command is applied / invalidated /
+    truncated — the shared termination predicate behind WaitUntilApplied,
+    local barriers, and ephemeral dep waits."""
+
+    __slots__ = ("on_fired", "fired")
+
+    def __init__(self, on_fired):
+        self.on_fired = on_fired
+        self.fired = False
+
+    @classmethod
+    def arm(cls, command: "Command", on_fired) -> "OnAppliedListener":
+        listener = cls(on_fired)
+        command.add_transient_listener(listener)
+        listener.maybe_fire(command)
+        return listener
+
+    def on_change(self, safe_store, command: "Command") -> None:
+        self.maybe_fire(command)
+
+    def maybe_fire(self, command: "Command") -> None:
+        if self.fired:
+            return
+        if command.is_applied_or_gone or command.is_truncated:
+            self.fired = True
+            command.remove_transient_listener(self)
+            self.on_fired(command)
+
+
 class Command:
     __slots__ = (
         "txn_id", "save_status", "durability",

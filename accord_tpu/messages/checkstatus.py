@@ -1,0 +1,124 @@
+"""CheckStatus: interrogate peers about a transaction, merging knowledge.
+
+Reference: accord/messages/CheckStatus.java:78 — IncludeInfo levels (No/
+Route/All), CheckStatusOk / CheckStatusOkFull replies whose `merge` keeps the
+maximum knowledge per field. Used by FindRoute (route discovery), MaybeRecover
+(has anyone progressed?), and FetchData (pull definition/deps/outcome).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from accord_tpu.local.status import Durability, SaveStatus
+from accord_tpu.messages.base import MessageType, Reply, TxnRequest
+from accord_tpu.primitives.deps import Deps
+from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
+from accord_tpu.primitives.txn import PartialTxn
+from accord_tpu.primitives.writes import Writes
+
+
+class IncludeInfo(enum.Enum):
+    NO = "No"
+    ROUTE = "Route"
+    ALL = "All"
+
+
+class CheckStatusOk(Reply):
+    """Everything one replica knows (CheckStatus.CheckStatusOk; with
+    include_info=ALL also the Full fields: definition, deps, outcome)."""
+
+    type = MessageType.CHECK_STATUS_RSP
+
+    def __init__(self, save_status: SaveStatus, promised: Ballot,
+                 accepted: Ballot, execute_at: Optional[Timestamp],
+                 durability: Durability, route: Optional[Route],
+                 is_coordinating: bool = False,
+                 partial_txn: Optional[PartialTxn] = None,
+                 stable_deps: Optional[Deps] = None,
+                 writes: Optional[Writes] = None, result=None):
+        self.save_status = save_status
+        self.promised = promised
+        self.accepted = accepted
+        self.execute_at = execute_at
+        self.durability = durability
+        self.route = route
+        self.is_coordinating = is_coordinating
+        self.partial_txn = partial_txn
+        self.stable_deps = stable_deps
+        self.writes = writes
+        self.result = result
+
+    def merge(self, other: "CheckStatusOk") -> "CheckStatusOk":
+        """Field-wise maximum knowledge (CheckStatusOk.merge)."""
+        hi, lo = (self, other) if self.save_status >= other.save_status \
+            else (other, self)
+        route = hi.route
+        if route is None or (lo.route is not None and lo.route.is_full
+                             and not route.is_full):
+            route = lo.route if lo.route is not None else route
+        elif route is not None and lo.route is not None \
+                and not route.is_full and not lo.route.is_full:
+            route = route.with_(lo.route)
+        return CheckStatusOk(
+            hi.save_status,
+            Ballot.max(self.promised, other.promised),
+            Ballot.max(self.accepted, other.accepted),
+            hi.execute_at if hi.execute_at is not None else lo.execute_at,
+            max(self.durability, other.durability),
+            route,
+            self.is_coordinating or other.is_coordinating,
+            hi.partial_txn if hi.partial_txn is not None else lo.partial_txn,
+            hi.stable_deps if hi.stable_deps is not None else lo.stable_deps,
+            hi.writes if hi.writes is not None else lo.writes,
+            hi.result if hi.result is not None else lo.result,
+        )
+
+    def __repr__(self):
+        return (f"CheckStatusOk({self.save_status.name}, "
+                f"at={self.execute_at!r}, route={self.route!r})")
+
+
+class CheckStatusNack(Reply):
+    type = MessageType.CHECK_STATUS_RSP
+
+    def __repr__(self):
+        return "CheckStatusNack"
+
+
+class CheckStatus(TxnRequest):
+    type = MessageType.CHECK_STATUS_REQ
+
+    def __init__(self, txn_id: TxnId, scope: Route,
+                 include_info: IncludeInfo = IncludeInfo.ROUTE):
+        super().__init__(txn_id, scope)
+        self.include_info = include_info
+
+    def apply(self, safe_store) -> Reply:
+        cmd = safe_store.if_present(self.txn_id)
+        if cmd is None:
+            return CheckStatusOk(SaveStatus.NOT_DEFINED, Ballot.ZERO,
+                                 Ballot.ZERO, None, Durability.NOT_DURABLE,
+                                 None)
+        full = self.include_info == IncludeInfo.ALL
+        return CheckStatusOk(
+            cmd.save_status, cmd.promised, cmd.accepted_ballot,
+            cmd.execute_at, cmd.durability,
+            cmd.route if self.include_info != IncludeInfo.NO else None,
+            is_coordinating=self.txn_id in safe_store.node.coordinating,
+            partial_txn=cmd.partial_txn if full else None,
+            stable_deps=cmd.stable_deps if full else None,
+            writes=cmd.writes if full else None,
+            result=cmd.result if full else None)
+
+    def reduce(self, a: Reply, b: Reply) -> Reply:
+        if isinstance(a, CheckStatusNack):
+            return b
+        if isinstance(b, CheckStatusNack):
+            return a
+        return a.merge(b)
+
+    def __repr__(self):
+        return f"CheckStatus({self.txn_id!r}, {self.include_info.value})"

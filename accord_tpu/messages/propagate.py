@@ -1,0 +1,82 @@
+"""Propagate: apply knowledge learned remotely to the local stores.
+
+Reference: accord/messages/Propagate.java:62 — a LOCAL request (never crosses
+the network) that walks a merged CheckStatusOk into the local command state:
+invalidation first, then outcome (apply), then stable deps (commit), then
+executeAt (precommit), then the definition (preaccept). Each tier only fires
+if the remote knowledge actually exceeds what this store already has; the
+regular transition functions enforce monotonicity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from accord_tpu.local import commands as C
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.messages.base import MessageType, Reply, SimpleReply, TxnRequest
+from accord_tpu.messages.checkstatus import CheckStatusOk
+from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import TxnId
+
+
+class Propagate(TxnRequest):
+    type = MessageType.PROPAGATE_OTHER_MSG
+
+    def __init__(self, txn_id: TxnId, scope: Route, known: CheckStatusOk):
+        super().__init__(txn_id, scope)
+        self.known = known
+
+    def process(self, node, from_id, reply_context) -> None:
+        node.map_reduce_consume_local(self, from_id, None)
+
+    def apply(self, safe_store) -> Reply:
+        k = self.known
+        cmd = safe_store.get(self.txn_id)
+        route = k.route if k.route is not None else self.route
+
+        if k.save_status == SaveStatus.INVALIDATED:
+            C.commit_invalidate(safe_store, self.txn_id)
+            return SimpleReply(SimpleReply.OK)
+        if k.save_status.is_truncated:
+            # remote state is gone; nothing to learn here (Infer territory)
+            return SimpleReply(SimpleReply.OK)
+
+        local = k.partial_txn.slice(safe_store.ranges, include_query=False) \
+            if k.partial_txn is not None and not safe_store.ranges.is_empty \
+            else k.partial_txn
+        deps = k.stable_deps.slice(safe_store.ranges) \
+            if k.stable_deps is not None and not safe_store.ranges.is_empty \
+            else k.stable_deps
+
+        if k.save_status >= SaveStatus.PRE_APPLIED and k.writes is not None \
+                and k.execute_at is not None and deps is not None:
+            C.apply(safe_store, self.txn_id, route, k.execute_at, deps,
+                    k.writes, k.result, partial_txn=local)
+            return SimpleReply(SimpleReply.OK)
+        if k.save_status >= SaveStatus.STABLE and k.execute_at is not None \
+                and deps is not None and not cmd.has_been(SaveStatus.STABLE):
+            C.commit(safe_store, self.txn_id, route, local, k.execute_at,
+                     deps, stable=True)
+            return SimpleReply(SimpleReply.OK)
+        if k.save_status >= SaveStatus.COMMITTED and k.execute_at is not None \
+                and deps is not None and not cmd.has_been(SaveStatus.COMMITTED):
+            C.commit(safe_store, self.txn_id, route, local, k.execute_at,
+                     deps, stable=False)
+            return SimpleReply(SimpleReply.OK)
+        if k.save_status >= SaveStatus.PRE_COMMITTED \
+                and k.execute_at is not None \
+                and not cmd.has_been(SaveStatus.PRE_COMMITTED):
+            C.precommit(safe_store, self.txn_id, k.execute_at)
+            return SimpleReply(SimpleReply.OK)
+        if k.save_status >= SaveStatus.PRE_ACCEPTED and local is not None \
+                and not cmd.has_been(SaveStatus.PRE_ACCEPTED):
+            C.preaccept(safe_store, self.txn_id, local, route)
+            return SimpleReply(SimpleReply.OK)
+        return SimpleReply(SimpleReply.OK)
+
+    def reduce(self, a, b):
+        return a
+
+    def __repr__(self):
+        return f"Propagate({self.txn_id!r}, {self.known.save_status.name})"

@@ -34,23 +34,6 @@ class _NotifyOnCommit(TransientListener):
             self.result.try_success(SimpleReply(SimpleReply.OK))
 
 
-class _NotifyOnApplied(TransientListener):
-    def __init__(self, result: AsyncResult):
-        self.result = result
-        self.done = False
-
-    def on_change(self, safe_store, command: Command) -> None:
-        self.maybe_fire(command)
-
-    def maybe_fire(self, command: Command) -> None:
-        if self.done:
-            return
-        if command.is_applied_or_gone or command.is_truncated:
-            self.done = True
-            command.remove_transient_listener(self)
-            self.result.try_success(SimpleReply(SimpleReply.OK))
-
-
 class WaitUntilApplied(TxnRequest):
     """Block until the txn has applied locally, then ack
     (accord/messages/WaitUntilApplied — WAIT_UNTIL_APPLIED_REQ). Used by
@@ -63,12 +46,12 @@ class WaitUntilApplied(TxnRequest):
         super().__init__(txn_id, scope)
 
     def apply(self, safe_store):
+        from accord_tpu.local.command import OnAppliedListener
         command = safe_store.get(self.txn_id)
         result: AsyncResult = AsyncResult()
-        listener = _NotifyOnApplied(result)
-        command.add_transient_listener(listener)
-        listener.maybe_fire(command)
-        if not listener.done and not command.has_been(SaveStatus.STABLE):
+        listener = OnAppliedListener.arm(
+            command, lambda c: result.try_success(SimpleReply(SimpleReply.OK)))
+        if not listener.fired and not command.has_been(SaveStatus.STABLE):
             safe_store.progress_log.waiting(
                 self.txn_id, safe_store.store, "Applied", command.route,
                 self.scope.participants())
