@@ -115,6 +115,7 @@ class ExecutePath(Callback):
         self.read_data = None
         self.executed = False
         self.failed = False
+        self.durable_sent = False
 
     def start(self) -> None:
         self.node.with_epoch(self.execute_at.epoch, self._start)
@@ -156,9 +157,11 @@ class ExecutePath(Callback):
 
     def on_round_failure(self, round_id, from_id: int,
                          failure: BaseException) -> None:
-        if self.applied_result is None or self.applied_result.is_done:
+        if self.applied_tracker is None or self.durable_sent:
             return
-        if self.applied_tracker.record_failure(from_id) == RequestStatus.FAILED:
+        if self.applied_tracker.record_failure(from_id) == RequestStatus.FAILED \
+                and self.applied_result is not None \
+                and not self.applied_result.is_done:
             self.applied_result.try_failure(
                 failure if isinstance(failure, Timeout)
                 else Exhausted(repr(failure)))
@@ -234,10 +237,11 @@ class ExecutePath(Callback):
         maximal = self.apply_kind == ApplyKind.MAXIMAL
         topologies = self.node.topology.with_unsynced_epochs(
             self.route.participants(), self.txn_id.epoch, self.execute_at.epoch)
-        apply_cb = None
-        if self.applied_result is not None:
-            self.applied_tracker = QuorumTracker(topologies)
-            apply_cb = RoundCallback(self, "apply")
+        # apply acks are always tracked: a quorum per shard makes the txn
+        # majority-durable, which is gossiped via InformDurable so progress
+        # logs stand down (the reference Persist round, Persist.java)
+        self.applied_tracker = QuorumTracker(topologies)
+        apply_cb = RoundCallback(self, "apply")
         for to in topologies.nodes():
             scope = TxnRequest.compute_scope(to, topologies, self.route)
             if scope is None:
@@ -251,17 +255,30 @@ class ExecutePath(Callback):
                 callback=apply_cb)
         self.result.try_success(result)
 
-    # -- apply acks (only when applied_result tracking was requested) --
+    # -- apply acks --
     def _on_apply_reply(self, from_id: int, reply: ApplyReply) -> None:
-        if self.applied_result is None or self.applied_result.is_done:
+        if self.applied_tracker is None or self.durable_sent:
             return
         if reply.outcome == ApplyReply.INSUFFICIENT:
-            if self.applied_tracker.record_failure(from_id) == RequestStatus.FAILED:
+            if self.applied_tracker.record_failure(from_id) == RequestStatus.FAILED \
+                    and self.applied_result is not None \
+                    and not self.applied_result.is_done:
                 self.applied_result.try_failure(
                     Exhausted("apply quorum unreachable"))
             return
         if self.applied_tracker.record_success(from_id) == RequestStatus.SUCCESS:
-            self.applied_result.try_success(None)
+            self.durable_sent = True
+            self._inform_durable()
+            if self.applied_result is not None:
+                self.applied_result.try_success(None)
+
+    def _inform_durable(self) -> None:
+        from accord_tpu.local.status import Durability
+        from accord_tpu.messages.durability import InformDurable
+        self.node.send_to_route(
+            self.route, self.txn_id.epoch, self.execute_at.epoch,
+            lambda to, scope: InformDurable(self.txn_id, scope,
+                                            Durability.MAJORITY))
 
     def _obsolete(self) -> None:
         """A competing coordinator persisted the outcome first; our read

@@ -70,13 +70,16 @@ class SimpleProgressLog(ProgressLog):
     # ----------------------------------------------------- state callbacks --
     def update(self, store, txn_id: TxnId, command) -> None:
         now = self._now_s()
+        # home monitoring stands down once the outcome is durable anywhere;
+        # blocked entries are LOCAL waits and clear only when locally
+        # satisfied (majority durability elsewhere doesn't apply us)
         if command.is_applied_or_gone or command.durability.is_durable:
             self.home.pop(txn_id, None)
-            self.blocked.pop(txn_id, None)
-            return
         blocked = self.blocked.get(txn_id)
         if blocked is not None and _blocked_satisfied(command, blocked):
             self.blocked.pop(txn_id, None)
+        if command.is_applied_or_gone or command.durability.is_durable:
+            return
         if not self._is_home(command):
             return
         state = self.home.get(txn_id)
@@ -102,7 +105,7 @@ class SimpleProgressLog(ProgressLog):
     def durable(self, command) -> None:
         if command.durability.is_durable:
             self.home.pop(command.txn_id, None)
-            self.blocked.pop(command.txn_id, None)
+            # blocked waits are local; see update()
 
     def clear(self, txn_id: TxnId) -> None:
         self.home.pop(txn_id, None)

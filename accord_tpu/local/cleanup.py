@@ -1,0 +1,114 @@
+"""Cleanup: the GC decision ladder and the store truncation sweep.
+
+Reference: accord/local/Cleanup.java:37-44 — NO / TRUNCATE_WITH_OUTCOME /
+TRUNCATE / ERASE computed from durability class + redundancy; applied by
+Commands.purge (Commands.java:879-967). A command may only be truncated once
+its outcome is durable at a majority of every participating shard (it can
+then be reconstructed from peers), and only erased once universally durable
+(no peer will ever ask for it again).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List
+
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.primitives.keys import Keys, Ranges
+from accord_tpu.primitives.timestamp import TxnId
+
+
+class Cleanup(enum.Enum):
+    NO = "NO"
+    # metadata (deps/txn/waiting) dropped, outcome (writes/result) kept: a
+    # lagging replica of this or another shard can still fetch the outcome
+    TRUNCATE_WITH_OUTCOME = "TRUNCATE_WITH_OUTCOME"
+    ERASE = "ERASE"
+
+
+def should_cleanup(store, cmd) -> Cleanup:
+    """GC decision for one command (Cleanup.shouldCleanup)."""
+    if cmd.is_truncated:
+        return Cleanup.NO
+    if cmd.is_invalidated:
+        # invalidated txns are safe to erase once universally durable bounds
+        # pass them (nobody can resurrect a lower ballot)
+        participants = _participants(store, cmd)
+        if participants is not None and _fully(
+                store.durable_before.is_universally_durable, cmd.txn_id,
+                participants):
+            return Cleanup.ERASE
+        return Cleanup.NO
+    if not cmd.has_been(SaveStatus.APPLIED):
+        return Cleanup.NO
+    participants = _participants(store, cmd)
+    if participants is None:
+        return Cleanup.NO
+    if _fully(store.durable_before.is_universally_durable, cmd.txn_id,
+              participants):
+        # every replica of this shard applied it; peers of other shards ask
+        # their own shard for the outcome — nothing can need ours again
+        return Cleanup.ERASE
+    if _fully(store.durable_before.is_majority_durable, cmd.txn_id,
+              participants):
+        return Cleanup.TRUNCATE_WITH_OUTCOME
+    return Cleanup.NO
+
+
+def _participants(store, cmd):
+    """Local slice of the command's participants: the durable bounds in this
+    store's map only ever cover its own ranges."""
+    parts = None
+    if cmd.partial_txn is not None:
+        parts = cmd.partial_txn.keys
+    elif cmd.route is not None:
+        parts = cmd.route.participants()
+    if parts is None or store.ranges.is_empty:
+        return parts
+    sliced = parts.slice(store.ranges)
+    if isinstance(sliced, Ranges):
+        return sliced if not sliced.is_empty else None
+    return sliced if len(sliced) > 0 else None
+
+
+def _fully(pred, txn_id: TxnId, participants) -> bool:
+    if isinstance(participants, Ranges):
+        if participants.is_empty:
+            return False
+        # probe both edges of every range (bounds are range-mapped)
+        from accord_tpu.primitives.keys import RoutingKey
+        return all(pred(txn_id, RoutingKey(r.start))
+                   and pred(txn_id, RoutingKey(r.end - 1))
+                   for r in participants)
+    if len(participants) == 0:
+        return False
+    return all(pred(txn_id, k) for k in participants)
+
+
+def sweep(store) -> int:
+    """Truncate/erase everything the durable bounds allow; prune the per-key
+    conflict indexes below the majority bound. Returns commands purged
+    (the restoreInvalidated/purge sweep driven by SetShardDurable /
+    SetGloballyDurable in the reference)."""
+    from accord_tpu.local import commands as C
+    from accord_tpu.local.store import SafeCommandStore, PreLoadContext
+
+    safe = SafeCommandStore(store, PreLoadContext.empty())
+    purged = 0
+    for txn_id in list(store.commands):
+        cmd = store.commands[txn_id]
+        decision = should_cleanup(store, cmd)
+        if decision == Cleanup.NO:
+            continue
+        C.purge(safe, txn_id, erase=decision == Cleanup.ERASE,
+                keep_outcome=decision == Cleanup.TRUNCATE_WITH_OUTCOME)
+        purged += 1
+        if txn_id in store.range_commands:
+            del store.range_commands[txn_id]
+    # prune conflict indexes below each key's majority bound: everything
+    # below it is decided and reconstructible from a majority elsewhere
+    for key, cfk in store.cfks.items():
+        bound = store.durable_before.majority_before(key)
+        if bound.hlc > 0:
+            cfk.prune_redundant(bound)
+    return purged

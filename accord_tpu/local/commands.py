@@ -95,6 +95,21 @@ def propose_execute_at(safe_store: SafeCommandStore, txn_id: TxnId,
     return node.unique_now_at_least(floor)
 
 
+def is_shard_fenced(safe_store: SafeCommandStore, txn_id: TxnId,
+                    participants) -> bool:
+    """TxnIds below the shard-applied fence can never newly commit: every
+    replica of the shard applied an exclusive sync point that witnessed
+    everything before it, and refuses to witness stragglers
+    (RedundantBefore.shardAppliedOrInvalidatedBefore gating)."""
+    rb = safe_store.store.redundant_before
+    if isinstance(participants, Ranges):
+        from accord_tpu.primitives.keys import RoutingKey
+        return any(rb.is_shard_redundant(txn_id, RoutingKey(r.start))
+                   or rb.is_shard_redundant(txn_id, RoutingKey(r.end - 1))
+                   for r in participants)
+    return any(rb.is_shard_redundant(txn_id, k) for k in participants)
+
+
 # ---------------------------------------------------------------- preaccept --
 
 def preaccept(safe_store: SafeCommandStore, txn_id: TxnId,
@@ -119,6 +134,8 @@ def preaccept(safe_store: SafeCommandStore, txn_id: TxnId,
         cmd.partial_txn = partial_txn
     participants = (partial_txn.keys if partial_txn is not None
                     else route.participants())
+    if is_shard_fenced(safe_store, txn_id, participants):
+        return AcceptOutcome.TRUNCATED, None
     witnessed_at = propose_execute_at(safe_store, txn_id, participants,
                                       permit_fast_path=ballot == Ballot.ZERO)
     cmd.execute_at = witnessed_at
@@ -160,6 +177,9 @@ def recover(safe_store: SafeCommandStore, txn_id: TxnId,
         cmd.partial_txn = partial_txn
     participants = (partial_txn.keys if partial_txn is not None
                     else route.participants())
+    # NB: no shard-fence gate here, unlike preaccept: a fresh recovery
+    # witness votes slow-path with executeAt above the fence (safe), whereas
+    # refusing could fabricate evidence against a decided-elsewhere txn
     witnessed_at = propose_execute_at(safe_store, txn_id, participants,
                                       permit_fast_path=False,
                                       permit_expiry=False)
@@ -382,6 +402,12 @@ def _update_waiting_on_dep(safe_store: SafeCommandStore, cmd: Command,
         safe_store.progress_log.waiting(
             dep_id, safe_store.store, "Committed", dep.route,
             cmd.route.participants() if cmd.route else None)
+    elif not dep.has_been(SaveStatus.PRE_APPLIED):
+        # committed here but the outcome never arrived (Apply lost): chase it
+        # (the reference BlockedState with blockedUntil=HasOutcome)
+        safe_store.progress_log.waiting(
+            dep_id, safe_store.store, "Applied", dep.route,
+            cmd.route.participants() if cmd.route else None)
 
 
 def _is_redundant_dep(safe_store: SafeCommandStore, cmd: Command,
@@ -452,6 +478,16 @@ def _apply_writes(safe_store: SafeCommandStore, cmd: Command) -> None:
         for key in safe_store.owned_keys_of(cmd):
             tfk = safe_store.tfk(key)
             tfk.on_executed(cmd.execute_at, cmd.txn_id.kind.is_write)
+        # an applied exclusive sync point certifies everything below it on
+        # its ranges applied locally: advance the redundancy watermark
+        # (Commands.java ESP handling feeding RedundantBefore)
+        from accord_tpu.primitives.timestamp import TxnKind
+        if cmd.txn_id.kind == TxnKind.EXCLUSIVE_SYNC_POINT \
+                and cmd.partial_txn is not None \
+                and isinstance(cmd.partial_txn.keys, Ranges):
+            owned = cmd.partial_txn.keys.slice(safe_store.ranges) \
+                if not safe_store.ranges.is_empty else cmd.partial_txn.keys
+            store.redundant_before.update_locally_applied(owned, cmd.txn_id)
         cmd.set_status(SaveStatus.APPLIED)
         safe_store.register(cmd, InternalStatus.APPLIED)
         safe_store.progress_log.update(store, cmd.txn_id, cmd)
@@ -508,9 +544,11 @@ def set_durability(safe_store: SafeCommandStore, txn_id: TxnId,
 # --------------------------------------------------------------- truncation --
 
 def purge(safe_store: SafeCommandStore, txn_id: TxnId,
-          erase: bool = False) -> None:
+          erase: bool = False, keep_outcome: bool = False) -> None:
     """Truncate a durably-applied (or invalidated) command's local state
-    (Commands.purge :879-967)."""
+    (Commands.purge :879-967). `keep_outcome` retains writes/result (the
+    reference's TRUNCATE_WITH_OUTCOME) so lagging replicas can still fetch
+    the outcome through CheckStatus."""
     cmd = safe_store.get(txn_id)
     invariants.check_state(
         cmd.is_applied_or_gone or cmd.durability.is_durable,
@@ -519,8 +557,9 @@ def purge(safe_store: SafeCommandStore, txn_id: TxnId,
     cmd.partial_deps = None
     cmd.stable_deps = None
     cmd.waiting_on = None
-    cmd.writes = None
-    cmd.result = None
+    if not keep_outcome:
+        cmd.writes = None
+        cmd.result = None
     if cmd.is_invalidated:
         pass  # keep INVALIDATED as terminal state
     else:

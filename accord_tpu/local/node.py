@@ -225,6 +225,24 @@ class Node:
             else:
                 self.sink.send(to, request)
 
+    def send_to_route(self, route, min_epoch: int, max_epoch: int, make_msg,
+                      callback=None):
+        """Fan a message out to every node owning part of `route` across the
+        epoch window, with per-destination scope slicing; returns the
+        Topologies used (for tracker construction). `make_msg(to, scope)`
+        builds each message; None skips that destination."""
+        from accord_tpu.messages.base import TxnRequest
+        topologies = self.topology.with_unsynced_epochs(
+            route.participants(), min_epoch, max_epoch)
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, route)
+            if scope is None:
+                continue
+            msg = make_msg(to, scope)
+            if msg is not None:
+                self.send(to, msg, callback=callback)
+        return topologies
+
     def reply(self, to: int, reply_context, reply: Reply) -> None:
         self.sink.reply(to, reply_context, reply)
 

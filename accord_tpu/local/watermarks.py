@@ -7,7 +7,7 @@ DurableBefore.java:39-180, all backed by ReducingRangeMap (SURVEY.md §2.3/§2.8
 from __future__ import annotations
 
 import enum
-from typing import Optional
+from typing import Optional, Tuple
 
 from accord_tpu.primitives.keys import Keys, Ranges, RoutingKey, _SortedKeyList
 from accord_tpu.primitives.timestamp import Timestamp, TxnId, TXNID_NONE
@@ -211,3 +211,23 @@ class DurableBefore:
     def majority_before(self, key: RoutingKey) -> TxnId:
         e = self._map.get(key.token)
         return e.majority_before if e is not None else TXNID_NONE
+
+    def universal_before(self, key: RoutingKey) -> TxnId:
+        e = self._map.get(key.token)
+        return e.universal_before if e is not None else TXNID_NONE
+
+    def min_bounds(self, ranges: Ranges) -> Tuple[TxnId, TxnId]:
+        """Floor (majority, universal) bounds across `ranges`; any uncovered
+        span floors to NONE (the min-merge of DurableBefore.java's global
+        aggregation)."""
+        def fold(acc, v):
+            maj = v.majority_before if v is not None else TXNID_NONE
+            uni = v.universal_before if v is not None else TXNID_NONE
+            if acc is None:
+                return (maj, uni)
+            return (min(acc[0], maj), min(acc[1], uni))
+
+        result = None
+        for r in ranges:
+            result = self._map.fold_intersecting(r.start, r.end, fold, result)
+        return result if result is not None else (TXNID_NONE, TXNID_NONE)

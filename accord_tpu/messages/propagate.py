@@ -38,8 +38,10 @@ class Propagate(TxnRequest):
         if k.save_status == SaveStatus.INVALIDATED:
             C.commit_invalidate(safe_store, self.txn_id)
             return SimpleReply(SimpleReply.OK)
-        if k.save_status.is_truncated:
-            # remote state is gone; nothing to learn here (Infer territory)
+        if k.save_status.is_truncated and (k.writes is None
+                                           or k.execute_at is None):
+            # remote state is gone without a retained outcome; nothing to
+            # learn here (Infer territory)
             return SimpleReply(SimpleReply.OK)
 
         local = k.partial_txn.slice(safe_store.ranges, include_query=False) \
@@ -50,9 +52,17 @@ class Propagate(TxnRequest):
             else k.stable_deps
 
         if k.save_status >= SaveStatus.PRE_APPLIED and k.writes is not None \
-                and k.execute_at is not None and deps is not None:
-            C.apply(safe_store, self.txn_id, route, k.execute_at, deps,
-                    k.writes, k.result, partial_txn=local)
+                and k.execute_at is not None:
+            outcome = C.apply(safe_store, self.txn_id, route, k.execute_at,
+                              deps, k.writes, k.result, partial_txn=local)
+            if outcome == C.ApplyOutcome.INSUFFICIENT:
+                # truncated-with-outcome source (deps purged) and we are
+                # below STABLE: per-txn catch-up cannot order this write
+                # safely. The replica stays lagging until range bootstrap
+                # (DataStore.fetch) heals it wholesale — applying here with
+                # fabricated deps could reorder writes under the data
+                # plane's executeAt guard and diverge the replica.
+                pass
             return SimpleReply(SimpleReply.OK)
         if k.save_status >= SaveStatus.STABLE and k.execute_at is not None \
                 and deps is not None and not cmd.has_been(SaveStatus.STABLE):

@@ -122,9 +122,8 @@ class BeginRecovery(TxnRequest):
         if outcome == C.AcceptOutcome.REJECTED_BALLOT:
             return RecoverNack(cmd.promised)
         if outcome == C.AcceptOutcome.TRUNCATED:
-            # invalidated or locally shed: report what we know
-            status = cmd.save_status
-            return RecoverOk(self.txn_id, status, cmd.accepted_ballot,
+            # genuinely invalidated or locally shed: report what we know
+            return RecoverOk(self.txn_id, cmd.save_status, cmd.accepted_ballot,
                              cmd.execute_at, Deps.NONE, None, None,
                              None, None, False, Deps.NONE, Deps.NONE)
 

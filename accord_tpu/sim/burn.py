@@ -43,7 +43,8 @@ class BurnRun:
                  drop_prob: float = 0.0, rf: int = None, n_shards: int = 4,
                  concurrency: int = 8,
                  progress_log_factory="default", num_command_stores: int = 1,
-                 range_reads: bool = True):
+                 range_reads: bool = True, durability: bool = True,
+                 durability_cycle_s: float = None):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -63,6 +64,11 @@ class BurnRun:
         self.keys = keys
         self.concurrency = concurrency
         self.range_reads = range_reads
+        if durability:
+            # randomized cadence like the reference burn (Cluster.java:333)
+            cycle = (durability_cycle_s if durability_cycle_s is not None
+                     else 5.0 + self.rng.next_float() * 25.0)
+            self.cluster.start_durability_scheduling(shard_cycle_s=cycle)
         self.verifier = StrictSerializabilityVerifier()
         self.stats = BurnStats()
         self.next_value = 0

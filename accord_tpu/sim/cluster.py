@@ -88,6 +88,17 @@ class SimCluster:
         for node in self.nodes.values():
             node.on_topology_update(topology)
 
+    def start_durability_scheduling(self, shard_cycle_s: float = 30.0,
+                                    global_cycle_every: int = 4) -> None:
+        """Run the reference's rotating durability rounds on every node
+        (CoordinateDurabilityScheduling.java; burn Cluster.java:333-349)."""
+        from accord_tpu.coordinate.durability import \
+            CoordinateDurabilityScheduling
+        for node in self.nodes.values():
+            CoordinateDurabilityScheduling(
+                node, shard_cycle_s=shard_cycle_s,
+                global_cycle_every=global_cycle_every).start()
+
     # ----------------------------------------------------------- execution --
     def process_all(self, max_items: int = 1_000_000) -> int:
         return self.queue.drain(max_items=max_items)

@@ -122,10 +122,14 @@ class TestRecoverDecisions:
         res = recover(cluster, 3, txn_id, route)
         assert res.failure() is None
         value = res.value()
-        assert isinstance(value, ListResult)
-        # the recovered read observes the earlier committed append (the txn's
-        # own write applies after its read snapshot)
-        assert value.read_values[Key(10)] == (1,)
+        # the Result is only reconstructible when the recovery quorum
+        # includes a replica holding the query slice (the original
+        # coordinator); either way the accepted proposal must complete
+        assert value is None or isinstance(value, ListResult)
+        if isinstance(value, ListResult):
+            # the recovered read observes the earlier committed append (the
+            # txn's own write applies after its read snapshot)
+            assert value.read_values[Key(10)] == (1,)
         cluster.process_all()
         for n in cluster.nodes.values():
             assert n.data_store.get(Key(10)) == (1, 7)
