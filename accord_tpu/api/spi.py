@@ -150,6 +150,16 @@ class DataStore(abc.ABC):
         result.set_success(ranges)
         return result
 
+    # -- snapshot transfer primitives (bootstrap; DataStore.java fetch
+    #    implementations move data in host-defined snapshot units) --
+    def snapshot_ranges(self, ranges: "Ranges"):
+        """Opaque snapshot of everything stored within `ranges`."""
+        raise NotImplementedError
+
+    def install_snapshot(self, snapshot) -> None:
+        """Merge a peer's snapshot (idempotent; newest-write wins per key)."""
+        raise NotImplementedError
+
 
 class ProgressLog(abc.ABC):
     """Per-CommandStore liveness driver (reference api/ProgressLog.java:30-59).

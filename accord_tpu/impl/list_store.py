@@ -19,23 +19,33 @@ from accord_tpu.utils.async_chains import AsyncResult, success
 
 
 class ListStore(DataStore):
-    """key -> (list of appended values, last write timestamp)."""
+    """key -> executeAt-ordered list of (timestamp, value) appends.
+
+    Values carry their executeAt so replay is exactly idempotent and
+    bootstrap snapshots MERGE rather than replace: a rejoining replica that
+    missed one mid-history write still heals it even when its latest write
+    matches the source's (a last-timestamp guard would skip the whole key
+    and silently lose the gap)."""
 
     def __init__(self, node_id: int = 0):
         self.node_id = node_id
-        self.data: Dict[Key, List[int]] = {}
-        self.write_ts: Dict[Key, Timestamp] = {}
+        self.data: Dict[Key, List[Tuple[Timestamp, int]]] = {}
 
     def get(self, key: Key) -> Tuple[int, ...]:
-        return tuple(self.data.get(key, ()))
+        return tuple(v for _, v in self.data.get(key, ()))
 
     def append(self, key: Key, value: int, at: Timestamp) -> None:
-        prev = self.write_ts.get(key)
-        # idempotent replay guard: applies are ordered per key by executeAt
-        if prev is not None and at <= prev:
-            return
-        self.data.setdefault(key, []).append(value)
-        self.write_ts[key] = at
+        entries = self.data.setdefault(key, [])
+        lo, hi = 0, len(entries)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if entries[mid][0] < at:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < len(entries) and entries[lo][0] == at:
+            return  # replay
+        entries.insert(lo, (at, value))
 
     def keys_in(self, ranges: Ranges) -> List[Key]:
         """Data keys present within `ranges` (range-scan support; the
@@ -44,7 +54,16 @@ class ListStore(DataStore):
         return sorted(k for k in self.data if ranges.contains(k))
 
     def snapshot(self) -> Dict[int, Tuple[int, ...]]:
-        return {k.token: tuple(v) for k, v in self.data.items()}
+        return {k.token: self.get(k) for k in self.data}
+
+    # -- bootstrap snapshot transfer --
+    def snapshot_ranges(self, ranges: Ranges):
+        return {k: tuple(self.data[k]) for k in self.keys_in(ranges)}
+
+    def install_snapshot(self, snapshot) -> None:
+        for k, entries in snapshot.items():
+            for at, value in entries:
+                self.append(k, value, at)
 
 
 class ListData(Data):

@@ -140,6 +140,28 @@ class SimpleProgressLog(ProgressLog):
         state.investigating = False
         state.updated_at_s = self._now_s()
 
+    def _walk_to_root_blocker(self, txn_id: TxnId) -> TxnId:
+        """Follow the WaitingOn chain to the lowest unresolved dependency
+        (the reference's waiting-chain walker, SimpleProgressLog.java:77-714
+        following Command.WaitingOn bitsets): fetching/recovering a command
+        that is merely waiting on ITS deps achieves nothing — the root
+        blocker is what needs chasing."""
+        seen = set()
+        cur_id = txn_id
+        for _ in range(64):
+            if cur_id in seen:
+                break
+            seen.add(cur_id)
+            cmd = self.store.commands.get(cur_id)
+            if cmd is None or cmd.waiting_on is None \
+                    or not cmd.waiting_on.is_waiting:
+                break
+            nxt = cmd.waiting_on.next_waiting()
+            if nxt is None:
+                break
+            cur_id = nxt
+        return cur_id
+
     def _check_blocked(self, state: _BlockedState, now: float) -> None:
         cmd = self.store.commands.get(state.txn_id)
         if cmd is not None and _blocked_satisfied(cmd, state):
@@ -147,6 +169,30 @@ class SimpleProgressLog(ProgressLog):
             return
         deadline = state.since_s + self._grace_s * (1 + state.attempts)
         if now < deadline:
+            return
+        # a runnable command that merely missed its notification needs a
+        # nudge, not a fetch
+        if cmd is not None and cmd.save_status in (SaveStatus.STABLE,
+                                                   SaveStatus.PRE_APPLIED) \
+                and (cmd.waiting_on is None or not cmd.waiting_on.is_waiting):
+            from accord_tpu.local import commands as C
+            from accord_tpu.local.store import PreLoadContext
+            state.since_s = now
+            self.store.execute(PreLoadContext.for_txn(state.txn_id),
+                               lambda s: C.maybe_execute(
+                                   s, s.get(state.txn_id), False))
+            return
+        # chase the bottom of the waiting chain, not the middle
+        root = self._walk_to_root_blocker(state.txn_id)
+        if root != state.txn_id and root not in self.blocked:
+            root_cmd = self.store.commands.get(root)
+            until = ("Applied" if root_cmd is not None
+                     and root_cmd.has_been(SaveStatus.COMMITTED)
+                     else "Committed")
+            self.blocked[root] = _BlockedState(
+                root, root_cmd.route if root_cmd is not None else None,
+                until, now - self._grace_s,  # due immediately
+                participants=state.participants)
             return
         route = state.route or (cmd.route if cmd is not None else None)
         from accord_tpu.coordinate.fetch import fetch_data, find_route

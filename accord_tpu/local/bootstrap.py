@@ -1,0 +1,151 @@
+"""Bootstrap: live acquisition of newly-owned ranges.
+
+Reference: accord/local/Bootstrap.java:81-483 — each attempt fences the
+ranges with an ExclusiveSyncPoint (everything ordered below it is frozen into
+the source snapshot; everything above flows through normal replication to the
+new owner), copies the data via the DataStore fetch protocol, then marks the
+ranges safe to read and records `bootstrapped_at` in RedundantBefore so deps
+below the fence are treated as already-satisfied locally.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from accord_tpu.coordinate.syncpoint import CoordinateSyncPoint, SyncPoint
+from accord_tpu.messages.base import Callback
+from accord_tpu.messages.epoch import (FetchSnapshot, FetchSnapshotNack,
+                                       FetchSnapshotOk)
+from accord_tpu.primitives.keys import Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.utils.async_chains import AsyncResult
+
+
+class Bootstrap(Callback):
+    """One bootstrap attempt chain for `ranges` (Bootstrap.Attempt). Retries
+    itself (fresh fence) on failure — the reference defers the retry policy
+    to Agent.onFailedBootstrap."""
+
+    RETRY_DELAY_S = 1.0
+
+    def __init__(self, node, ranges: Ranges, epoch: int,
+                 result: Optional[AsyncResult] = None):
+        self.node = node
+        self.ranges = ranges
+        self.epoch = epoch
+        self.result = result if result is not None else AsyncResult()
+        self.sp: Optional[SyncPoint] = None
+        self.covered = Ranges.EMPTY
+        self.pending: Dict[int, Ranges] = {}
+        self.tried: set = set()
+        self.done = False
+
+    def start(self) -> "Bootstrap":
+        CoordinateSyncPoint.coordinate(
+            self.node, TxnKind.EXCLUSIVE_SYNC_POINT, self.ranges,
+            await_applied=False).add_callback(self._on_fence)
+        return self
+
+    def _retry(self) -> None:
+        if self.done:
+            return
+        self.node.scheduler.once(
+            self.RETRY_DELAY_S,
+            lambda: Bootstrap(self.node, self.ranges.subtract(self.covered),
+                              self.epoch, self.result).start()
+            if not self.result.is_done else None)
+
+    # ------------------------------------------------------------- fence --
+    def _on_fence(self, sp: Optional[SyncPoint], failure) -> None:
+        if failure is not None:
+            self._retry()
+            return
+        self.sp = sp
+        self._fetch_missing()
+
+    def _fetch_missing(self) -> None:
+        missing = self.ranges.subtract(self.covered)
+        if missing.is_empty:
+            self._finish()
+            return
+        # one source per shard: any current replica other than ourselves has
+        # the full sub-range once the fence applied there
+        topology = self.node.topology.for_epoch(self.epoch)
+        requested = False
+        sources_exist = False
+        for shard in topology.for_selection(missing).shards:
+            want = Ranges([shard.range]).slice(missing)
+            if want.is_empty:
+                continue
+            if any(n != self.node.id for n in shard.nodes):
+                sources_exist = True
+            source = self._pick_source(shard)
+            if source is None:
+                continue
+            requested = True
+            self.pending[source] = want
+            self.node.send(source, FetchSnapshot(self.sp.txn_id, want),
+                           callback=self, timeout_s=10.0)
+        if not requested and self.pending:
+            return  # earlier requests for other sub-ranges still in flight
+        if not requested:
+            if sources_exist:
+                # every source tried and failed this round: retry — finishing
+                # without the data would mark the range safe while missing
+                # history and diverge the replica
+                self.tried.clear()
+                self.node.scheduler.once(self.RETRY_DELAY_S,
+                                         self._fetch_missing)
+            else:
+                # genuinely no peer holds it (we are the only replica)
+                self._finish()
+
+    def _pick_source(self, shard) -> Optional[int]:
+        for n in shard.nodes:
+            if n != self.node.id and (n, shard.range.start) not in self.tried:
+                self.tried.add((n, shard.range.start))
+                return n
+        return None
+
+    # ------------------------------------------------------------ replies --
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        want = self.pending.pop(from_id, None)
+        if isinstance(reply, FetchSnapshotOk):
+            self.node.data_store.install_snapshot(reply.snapshot)
+            self.covered = self.covered.union(reply.ranges)
+            if want is not None and not want.subtract(reply.ranges).is_empty:
+                self._fetch_missing()  # partial coverage: try another source
+            elif self.ranges.subtract(self.covered).is_empty:
+                self._finish()
+            elif not self.pending:
+                self._fetch_missing()
+            return
+        # nack: try the next source for that sub-range
+        self._fetch_missing()
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        self.pending.pop(from_id, None)
+        self._fetch_missing()
+
+    # ------------------------------------------------------------- finish --
+    def _finish(self) -> None:
+        if self.done:
+            return
+        self.done = True
+        from accord_tpu.local import commands as C
+        from accord_tpu.local.store import PreLoadContext
+
+        for store in self.node.command_stores.intersecting(self.ranges):
+            owned = self.ranges.slice(store.ranges)
+            if owned.is_empty:
+                continue
+            store.redundant_before.set_bootstrapped_at(owned, self.sp.txn_id)
+            store.mark_safe_to_read(owned)
+            # deps below the fence are now satisfied by the snapshot:
+            # re-evaluate everything blocked on them
+            store.execute(PreLoadContext.empty(), C.re_evaluate_waiting)
+        self.result.try_success(self.ranges)

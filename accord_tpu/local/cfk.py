@@ -175,17 +175,47 @@ class CommandsForKey:
         return best
 
     # -- the deps scan (mapReduceActive, CommandsForKey.java:614-650) --
+    def _prune_bound(self, before: Timestamp):
+        """The max committed WRITE below `before` (by executeAt): every
+        decided txn it witnesses that executes before it is transitively
+        covered by depending on it (the reference's pruning below the max
+        committed write, CommandsForKey.java:614-650)."""
+        bound_id = None
+        bound_at = None
+        for t in self._ids:
+            if t >= before or not t.kind.is_write:
+                continue
+            info = self._by_id[t]
+            if not info.status.is_committed:
+                continue
+            at = info.execute_at_or_txn_id()
+            if bound_at is None or at > bound_at:
+                bound_at, bound_id = at, t
+        return bound_id, bound_at
+
     def map_reduce_active(self, before: Timestamp, kinds: KindSet,
-                          fn: Callable[[TxnId], None]) -> None:
+                          fn: Callable[[TxnId], None],
+                          prune: bool = True,
+                          deps_of: Callable[[TxnId], object] = None) -> None:
         """Visit every active txn with txnId < `before` whose kind is in
         `kinds` — the dependency calculation for a new txn at this key.
 
-        'Active' excludes invalidated/truncated txns and those pruned as
-        redundant; everything else (uncommitted or committed or applied) is a
-        dependency. (The reference additionally prunes txns transitively
-        covered by the max committed write — a strict optimization we apply in
-        the batched device path with an equivalence oracle.)
+        'Active' excludes invalidated/truncated txns, those pruned as
+        redundant, and (when `prune` and `deps_of` is given) txns
+        *provably* covered by the max committed write W*: t is pruned iff
+        W*'s locally-known committed deps CONTAIN t and t is decided to
+        execute before W* — then depending on W* transitively orders us
+        after t. Keeping deps bounded this way is what stops dependency sets
+        growing without limit between durability sweeps. The containment
+        check matters: inferring coverage from timestamps alone can prune a
+        txn the bound never actually witnessed, silently dropping it from
+        the execution order (the reference tracks exact witnessing via the
+        per-txn missing[] arrays, CommandsForKey.java:412-420).
         """
+        bound_id, bound_at = self._prune_bound(before) if prune \
+            else (None, None)
+        bound_deps = deps_of(bound_id) \
+            if bound_id is not None and deps_of is not None else None
         hi = find_ceil(self._ids, before)
         for i in range(hi):
             t = self._ids[i]
@@ -194,6 +224,11 @@ class CommandsForKey:
                 continue
             if t.kind not in kinds:
                 continue
+            if bound_deps is not None and t != bound_id \
+                    and info.status.is_decided \
+                    and info.execute_at_or_txn_id() < bound_at \
+                    and bound_deps.contains(t):
+                continue  # provably covered by the bound write
             fn(t)
 
     # -- recovery queries (mapReduceFull, CommandsForKey.java:553-612) --

@@ -391,6 +391,13 @@ def _update_waiting_on_dep(safe_store: SafeCommandStore, cmd: Command,
     if _is_redundant_dep(safe_store, cmd, dep_id):
         waiting_on.set_applied_or_invalidated(dep_id)
         return
+    # sync points carry no writes: a dependency on one is satisfied once its
+    # executeAt is decided — there is nothing of its to order reads/writes
+    # against (the reference waits deps-only txns via WaitingOn.Commit)
+    if dep_id.kind.is_sync_point and dep.has_been(SaveStatus.COMMITTED):
+        waiting_on.remove_waiting_on(dep_id)
+        dep.remove_listener(cmd.txn_id)
+        return
     if dep.save_status.is_committed_to_execute and cmd.execute_at is not None \
             and dep.execute_at is not None and dep.execute_at > cmd.execute_at:
         # ordered after us; not our problem
@@ -412,16 +419,39 @@ def _update_waiting_on_dep(safe_store: SafeCommandStore, cmd: Command,
 
 def _is_redundant_dep(safe_store: SafeCommandStore, cmd: Command,
                       dep_id: TxnId) -> bool:
+    """A dep below the local-applied or bootstrap watermark for EVERY
+    participant through which we recorded it is already reflected in local
+    state (applied, or frozen into the bootstrap snapshot) — don't wait on
+    it (RedundantBefore dep pruning)."""
     rb = safe_store.store.redundant_before
-    participants = None
-    dep = safe_store.store.commands.get(dep_id)
-    if dep is not None and dep.route is not None:
-        participants = dep.route.participants()
-    if participants is None or isinstance(participants, Ranges):
-        # conservative for range-domain deps: never skip
-        return False
-    return len(participants) > 0 and all(
-        rb.is_redundant(dep_id, k) for k in participants)
+    key_parts = None
+    range_parts = None
+    if cmd.stable_deps is not None:
+        key_parts, range_parts = cmd.stable_deps.participants(dep_id)
+        if not safe_store.ranges.is_empty:
+            # only the locally-recorded participants matter: WaitingOn was
+            # built from the store-sliced deps
+            key_parts = key_parts.slice(safe_store.ranges)
+            range_parts = range_parts.slice(safe_store.ranges)
+    if (key_parts is None or len(key_parts) == 0) \
+            and (range_parts is None or range_parts.is_empty):
+        dep = safe_store.store.commands.get(dep_id)
+        if dep is not None and dep.route is not None \
+                and dep.route.is_key_domain:
+            key_parts = dep.route.participants()
+        else:
+            return False
+    from accord_tpu.primitives.keys import RoutingKey
+    if key_parts is not None:
+        for k in key_parts:
+            if not rb.is_redundant(dep_id, k):
+                return False
+    if range_parts is not None and not range_parts.is_empty:
+        for r in range_parts:
+            if not (rb.is_redundant(dep_id, RoutingKey(r.start))
+                    and rb.is_redundant(dep_id, RoutingKey(r.end - 1))):
+                return False
+    return True
 
 
 def update_dependency_and_maybe_execute(safe_store: SafeCommandStore,
@@ -438,6 +468,22 @@ def update_dependency_and_maybe_execute(safe_store: SafeCommandStore,
         _update_waiting_on_dep(safe_store, waiter, dep.txn_id)
         if not waiter.waiting_on.is_waiting:
             maybe_execute(safe_store, waiter, always_notify=False)
+
+
+def re_evaluate_waiting(safe_store: SafeCommandStore) -> None:
+    """Re-test every blocked dependency against the (advanced) redundancy
+    watermarks — run after bootstrap completes, when deps below the fence
+    became satisfiable-by-snapshot (Bootstrap.java markBootstrapComplete ->
+    the reference's RedundantBefore-driven WaitingOn updates)."""
+    for cmd in list(safe_store.store.commands.values()):
+        waiting_on = cmd.waiting_on
+        if waiting_on is not None and waiting_on.is_waiting:
+            for dep_id in waiting_on.waiting_ids():
+                _update_waiting_on_dep(safe_store, cmd, dep_id)
+        if cmd.save_status in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED) \
+                and (waiting_on is None or not waiting_on.is_waiting):
+            # includes applies that were deferred on un-bootstrapped ranges
+            maybe_execute(safe_store, cmd, always_notify=False)
 
 
 def maybe_execute(safe_store: SafeCommandStore, cmd: Command,
@@ -459,10 +505,28 @@ def maybe_execute(safe_store: SafeCommandStore, cmd: Command,
         _notify_listeners(safe_store, cmd)
         return True
 
-    # PRE_APPLIED with no outstanding deps: run the writes
+    # PRE_APPLIED with no outstanding deps: run the writes — but never onto
+    # a range whose bootstrap hasn't installed its snapshot yet (applying
+    # out-of-band would interleave with the snapshot and diverge the
+    # replica; the reference defers via safeToRead/unavailableToExecute)
+    if not _safe_to_apply(safe_store, cmd):
+        return False  # re-driven by re_evaluate_waiting after bootstrap
     cmd.set_status(SaveStatus.APPLYING)
     _apply_writes(safe_store, cmd)
     return True
+
+
+def _safe_to_apply(safe_store: SafeCommandStore, cmd: Command) -> bool:
+    if safe_store.ranges.is_empty:
+        return True
+    sel = None
+    if cmd.partial_txn is not None:
+        sel = cmd.partial_txn.keys.slice(safe_store.ranges)
+    elif cmd.route is not None:
+        sel = cmd.route.slice(safe_store.ranges).participants()
+    if sel is None:
+        return True
+    return safe_store.is_safe_to_read(sel)
 
 
 def _apply_writes(safe_store: SafeCommandStore, cmd: Command) -> None:
@@ -478,16 +542,14 @@ def _apply_writes(safe_store: SafeCommandStore, cmd: Command) -> None:
         for key in safe_store.owned_keys_of(cmd):
             tfk = safe_store.tfk(key)
             tfk.on_executed(cmd.execute_at, cmd.txn_id.kind.is_write)
-        # an applied exclusive sync point certifies everything below it on
-        # its ranges applied locally: advance the redundancy watermark
-        # (Commands.java ESP handling feeding RedundantBefore)
-        from accord_tpu.primitives.timestamp import TxnKind
-        if cmd.txn_id.kind == TxnKind.EXCLUSIVE_SYNC_POINT \
-                and cmd.partial_txn is not None \
-                and isinstance(cmd.partial_txn.keys, Ranges):
-            owned = cmd.partial_txn.keys.slice(safe_store.ranges) \
-                if not safe_store.ranges.is_empty else cmd.partial_txn.keys
-            store.redundant_before.update_locally_applied(owned, cmd.txn_id)
+        # NB: a locally-applied ESP must NOT advance the locally-applied
+        # watermark: the bound is by TxnId, but a lower-id txn that the
+        # ESP never witnessed (preaccept in flight during its deps calc)
+        # can commit with executeAt AFTER the ESP — an id-based "all
+        # applied" claim would wrongly clear it from waiters and reorder
+        # writes. Only the durability fence (SetShardDurable universal,
+        # whose witness gate stops new lower-id commits) and bootstrap
+        # snapshots may advance redundancy watermarks.
         cmd.set_status(SaveStatus.APPLIED)
         safe_store.register(cmd, InternalStatus.APPLIED)
         safe_store.progress_log.update(store, cmd.txn_id, cmd)

@@ -95,12 +95,40 @@ class Node:
     # ------------------------------------------------------------ lifecycle --
     def on_topology_update(self, topology: Topology, start_sync: bool = True
                            ) -> Ranges:
-        """Feed a new epoch (reference Node.onTopologyUpdate :247-255).
-        Returns ranges newly owned by this node (bootstrap targets)."""
+        """Feed a new epoch (reference Node.onTopologyUpdate :247-255):
+        re-range the stores, bootstrap newly-owned ranges behind an
+        ExclusiveSyncPoint fence, then broadcast epoch-sync completion so
+        peers' TopologyManagers can unlock the epoch (§3.4). Returns the
+        ranges newly owned by this node."""
+        first = not self.topology.has_epoch(topology.epoch - 1) \
+            and self.topology.min_epoch in (0, topology.epoch)
         self.topology.on_topology_update(topology)
         owned = topology.ranges_for_node(self.id)
         added = self.command_stores.update_topology(owned)
+        epoch = topology.epoch
+
+        def synced(_v=None, _f=None):
+            self._broadcast_sync_complete(epoch, topology)
+
+        if added.is_empty or first or not start_sync:
+            # nothing to copy (or the genesis epoch: there is no data yet)
+            for store in self.command_stores.intersecting(added):
+                store.mark_safe_to_read(added)
+            if start_sync:
+                synced()
+        else:
+            from accord_tpu.local.bootstrap import Bootstrap
+            attempt = Bootstrap(self, added, epoch)
+            attempt.result.add_callback(synced)
+            attempt.start()
         return added
+
+    def _broadcast_sync_complete(self, epoch: int, topology: Topology) -> None:
+        from accord_tpu.messages.epoch import EpochSyncComplete
+        self.topology.on_epoch_sync_complete(self.id, epoch)
+        for to in sorted(topology.nodes()):
+            if to != self.id:
+                self.send(to, EpochSyncComplete(epoch))
 
     def progress_log_for(self, store) -> ProgressLog:
         pl = self._progress_logs.get(store.id)

@@ -102,6 +102,14 @@ class SafeCommandStore:
     def tfk(self, key: Key) -> TimestampsForKey:
         return self.store._tfk(key)
 
+    def is_safe_to_read(self, selection) -> bool:
+        """Is the data for `selection` (Keys or Ranges, already owned-sliced)
+        locally complete? (reference SafeToRead epochs)"""
+        safe = self.store.safe_to_read
+        if isinstance(selection, Ranges):
+            return selection.subtract(safe).is_empty
+        return all(safe.contains(k) for k in selection)
+
     def owned_keys_of(self, command: Command) -> Keys:
         """The command's participating data keys owned by this store. For
         range-domain commands, the keys with local conflict state inside the
@@ -171,10 +179,21 @@ class SafeCommandStore:
         is_range = isinstance(participants, Ranges)
         owned = self._owned_participants(participants)
         keys = self._owned_cfk_keys(owned) if is_range else owned
+
+        def deps_of(txn_id: TxnId):
+            """Committed deps of a local command, for transitive pruning."""
+            cmd = self.store.commands.get(txn_id)
+            if cmd is None:
+                return None
+            return cmd.stable_deps if cmd.stable_deps is not None \
+                else cmd.partial_deps
+
         for key in keys:
             cfk = self.store.cfks.get(key)
             if cfk is not None:
-                cfk.map_reduce_active(before, kinds, lambda t, k=key: fn(k, t))
+                cfk.map_reduce_active(before, kinds,
+                                      lambda t, k=key: fn(k, t),
+                                      deps_of=deps_of)
         # range-domain txns intersecting the participants are conflicts too
         for txn_id, ranges in self.store.range_commands.items():
             if not self._active_range_conflict(txn_id, before, kinds):
@@ -308,6 +327,10 @@ class CommandStore:
         self.id = store_id
         self.node = node
         self.ranges = ranges
+        # ranges whose data is locally complete (initial ownership, or
+        # bootstrap finished); reads outside it nack so the coordinator
+        # retries a caught-up replica (the reference SafeToRead epochs)
+        self.safe_to_read: Ranges = ranges
         self.commands: Dict[TxnId, Command] = {}
         self.cfks: Dict[Key, CommandsForKey] = {}
         self.tfks: Dict[Key, TimestampsForKey] = {}
@@ -380,8 +403,21 @@ class CommandStore:
         if result is not None:
             result.set_success(value)
 
-    def update_ranges(self, ranges: Ranges) -> None:
-        self.ranges = ranges
+    def update_ranges(self, ranges: Ranges, unsafe: Ranges = None) -> None:
+        """Add the current epoch's assignment. Serving ranges only GROW (the
+        reference's per-epoch RangesForEpoch, CommandStore.java:96): old-epoch
+        messages — recovery of era transactions, fetches of their outcomes —
+        must still reach the command state this store accumulated while it
+        owned them. Routing for new epochs is the sender's job (scope
+        computation against the current topology). `unsafe` = node-level
+        newly-acquired ranges pending bootstrap."""
+        self.ranges = self.ranges.union(ranges)
+        fresh = ranges.subtract(unsafe) if unsafe is not None else ranges
+        self.safe_to_read = self.safe_to_read.union(fresh)
+
+    def mark_safe_to_read(self, ranges: Ranges) -> None:
+        self.safe_to_read = self.safe_to_read.union(
+            ranges.slice(self.ranges) if not self.ranges.is_empty else ranges)
 
     def __repr__(self):
         return f"CommandStore#{self.id}({self.ranges!r})"
@@ -459,10 +495,11 @@ class CommandStores:
         old = Ranges.EMPTY
         for s in self.stores:
             old = old.union(s.ranges)
+        added = ranges.subtract(old)
         splits = self._splitter.split(ranges)
         for i, s in enumerate(self.stores):
-            s.update_ranges(splits[i])
-        return ranges.subtract(old)
+            s.update_ranges(splits[i], unsafe=added)
+        return added
 
     def all(self) -> List[CommandStore]:
         return list(self.stores)

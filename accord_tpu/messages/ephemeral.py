@@ -127,6 +127,8 @@ class ReadEphemeralTxnData(TxnRequest):
             if not safe_store.ranges.is_empty else self.read_keys
         if txn.read is None or not owned:
             return ReadOk(None)
+        if not safe_store.is_safe_to_read(owned):
+            return ReadNack(ReadNack.UNAVAILABLE)
 
         def do_read():
             # read "now": the snapshot after every collected write dep — the

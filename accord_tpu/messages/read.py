@@ -43,6 +43,7 @@ class ReadNack(Reply):
     INVALID = "Invalid"       # command invalidated
     REDUNDANT = "Redundant"   # already applied/truncated elsewhere
     NOT_COMMITTED = "NotCommitted"
+    UNAVAILABLE = "Unavailable"  # data not yet bootstrapped locally
 
     def __init__(self, reason: str):
         self.reason = reason
@@ -85,6 +86,17 @@ class _ReadWhenReady(TransientListener):
             if not safe_store.ranges.is_empty else self.keys
         if txn is None or txn.read is None or not owned:
             self._finish(command, ReadOk(None))
+            return
+        if not safe_store.is_safe_to_read(owned):
+            self._finish(command, ReadNack(ReadNack.UNAVAILABLE))
+            return
+        from accord_tpu.local.watermarks import PreBootstrapOrStale
+        if safe_store.store.redundant_before.pre_bootstrap_or_stale(
+                self.txn_id, owned) != PreBootstrapOrStale.POST_BOOTSTRAP:
+            # our bootstrap snapshot may already embed this txn's own writes
+            # (and its successors'): the pre-execution snapshot no longer
+            # exists here — another replica must serve it
+            self._finish(command, ReadNack(ReadNack.UNAVAILABLE))
             return
         self.done = True
         command.remove_transient_listener(self)

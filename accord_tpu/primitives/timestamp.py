@@ -272,6 +272,8 @@ class TxnId(Timestamp):
         return Timestamp(self.epoch, self.hlc, self.flags, self.node)
 
     def __repr__(self):
+        if self.msb == 0 and self.lsb == 0 and self.node == 0:
+            return "TxnId.NONE"
         return (f"{self.kind.name[0]}{'R' if self.is_range_domain else ''}"
                 f"[{self.epoch},{self.hlc},{self.node}]")
 

@@ -44,7 +44,9 @@ class BurnRun:
                  concurrency: int = 8,
                  progress_log_factory="default", num_command_stores: int = 1,
                  range_reads: bool = True, durability: bool = True,
-                 durability_cycle_s: float = None):
+                 durability_cycle_s: float = None,
+                 topology_changes: bool = True,
+                 topology_period_s: float = 3.0):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -69,6 +71,12 @@ class BurnRun:
             cycle = (durability_cycle_s if durability_cycle_s is not None
                      else 5.0 + self.rng.next_float() * 25.0)
             self.cluster.start_durability_scheduling(shard_cycle_s=cycle)
+        self.nemesis = None
+        if topology_changes:
+            from accord_tpu.sim.topology_nemesis import TopologyRandomizer
+            self.nemesis = TopologyRandomizer(self.cluster, self.rng.fork(),
+                                              period_s=topology_period_s)
+            self.nemesis.start()
         self.verifier = StrictSerializabilityVerifier()
         self.stats = BurnStats()
         self.next_value = 0
@@ -162,9 +170,13 @@ class BurnRun:
         cluster.process_until(
             lambda: submitted[0] >= self.ops and inflight[0] == 0,
             max_items=50_000_000)
+        # quiesce: stop mutating topology, then let replication/recovery
+        # drain (the reference burn similarly settles before verifying)
+        if self.nemesis is not None:
+            self.nemesis.stop()
         cluster.queue.drain(
-            until_us=cluster.queue.clock.now_us + 10_000_000,
-            max_items=2_000_000)
+            until_us=cluster.queue.clock.now_us + 60_000_000,
+            max_items=5_000_000)
         self.stats.pending = inflight[0]
         tally = (self.stats.acks + self.stats.nacks + self.stats.lost
                  + self.stats.pending)

@@ -69,7 +69,8 @@ def scalar_edges_per_sec(cfks, batch):
     t0 = time.perf_counter()
     for tid, keyset in batch:
         for k in keyset:
-            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), count)
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), count,
+                                      prune=False)
     dt = time.perf_counter() - t0
     return edges / dt, edges
 

@@ -52,7 +52,8 @@ def scalar_deps(cfks, batch):
     for tid, keys in batch:
         ids = set()
         for k in keys:
-            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.add)
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.add,
+                                      prune=False)
         out.append(sorted(ids))
     return out
 
@@ -77,7 +78,8 @@ def test_batched_deps_matches_scalar(seed):
     for (tid, keys), m in zip(batch, keyed):
         for k in keys:
             ids = []
-            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.append)
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.append,
+                                          prune=False)
             assert m.get(k, []) == sorted(ids)
 
 

@@ -255,8 +255,13 @@ class TestProgressLog:
         dependent = cluster.node(2).coordinate(rw_txn([10], {10: 2}))
         assert cluster.process_until(lambda: dependent.is_done,
                                      max_items=500_000)
-        assert dependent.failure() is None
-        assert dependent.value().read_values[Key(10)] == (1,)
+        if dependent.failure() is None:
+            assert dependent.value().read_values[Key(10)] == (1,)
+        else:
+            # the progress log may race the slow coordinator, persist the
+            # outcome first, and preempt it — the write still lands
+            from accord_tpu.coordinate.errors import Preempted
+            assert isinstance(dependent.failure(), Preempted)
         done = cluster.process_until(
             lambda: all(n.data_store.get(Key(10)) == (1, 2)
                         for n in cluster.nodes.values()),

@@ -1,0 +1,159 @@
+"""Runtime topology change: epoch sync, bootstrap, membership moves.
+
+Reference model: Node.onTopologyUpdate -> CommandStores.updateTopology ->
+Bootstrap (Bootstrap.java:81-483, ESP fence + DataStore.fetch),
+TopologyManager epoch sync quorum (§3.4), TopologyRandomizer nemesis
+(TopologyRandomizer.java:109-115).
+"""
+
+import pytest
+
+from accord_tpu.impl.list_store import ListQuery, ListRead, ListResult, ListUpdate
+from accord_tpu.primitives.keys import Key, Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.burn import BurnRun
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+
+
+def rw_txn(read_tokens, appends: dict):
+    keys = Keys.of(*(set(read_tokens) | set(appends)))
+    return Txn(TxnKind.WRITE if appends else TxnKind.READ, keys,
+               read=ListRead(Keys.of(*read_tokens)) if read_tokens else None,
+               query=ListQuery(),
+               update=ListUpdate({Key(t): v for t, v in appends.items()})
+               if appends else None)
+
+
+def run_txn(cluster, node_id, txn):
+    result = cluster.node(node_id).coordinate(txn)
+    ok = cluster.process_until(lambda: result.is_done, max_items=2_000_000)
+    assert ok, "txn did not complete"
+    if result.failure() is not None:
+        raise result.failure()
+    return result.value()
+
+
+def swap_replica(topology: Topology, token: int, leave: int, join: int
+                 ) -> Topology:
+    shards = []
+    for s in topology.shards:
+        if s.range.contains_token(token):
+            nodes = tuple(join if n == leave else n for n in s.nodes)
+            shards.append(Shard(s.range, nodes))
+        else:
+            shards.append(s)
+    return Topology(topology.epoch + 1, shards)
+
+
+class TestMembershipChange:
+    def test_new_replica_bootstraps_data(self):
+        """Node 4 joins the shard owning key 5 and must serve its history."""
+        cluster = SimCluster(n_nodes=4, seed=61, n_shards=2, rf=3)
+        for v in range(3):
+            run_txn(cluster, 1, rw_txn([], {5: v}))
+        cluster.process_all()
+        old_shard = cluster.topology.shard_for_token(5)
+        assert 4 not in old_shard.nodes
+        leave = old_shard.nodes[0]
+        new_top = swap_replica(cluster.topology, 5, leave, 4)
+        cluster.update_topology(new_top)
+        cluster.process_all()
+        # node 4 bootstrapped the data
+        assert cluster.node(4).data_store.get(Key(5)) == (0, 1, 2)
+        # and serves coordinated reads
+        r = run_txn(cluster, 4, rw_txn([5], {}))
+        assert r.read_values[Key(5)] == (0, 1, 2)
+
+    def test_writes_continue_through_change(self):
+        cluster = SimCluster(n_nodes=4, seed=62, n_shards=2, rf=3)
+        run_txn(cluster, 1, rw_txn([], {5: 0}))
+        old_shard = cluster.topology.shard_for_token(5)
+        leave = old_shard.nodes[0]
+        new_top = swap_replica(cluster.topology, 5, leave, 4)
+        cluster.update_topology(new_top)
+        # write in the new epoch without waiting for quiescence
+        run_txn(cluster, 2, rw_txn([], {5: 1}))
+        cluster.process_all()
+        r = run_txn(cluster, 3, rw_txn([5], {}))
+        assert r.read_values[Key(5)] == (0, 1)
+        # all current owners converge
+        for nid in cluster.topology.shard_for_token(5).nodes:
+            assert cluster.node(nid).data_store.get(Key(5)) == (0, 1)
+
+    def test_epoch_sync_completes(self):
+        cluster = SimCluster(n_nodes=4, seed=63, n_shards=2, rf=3)
+        run_txn(cluster, 1, rw_txn([], {5: 0}))
+        new_top = swap_replica(cluster.topology, 5,
+                               cluster.topology.shard_for_token(5).nodes[0], 4)
+        cluster.update_topology(new_top)
+        cluster.process_all()
+        # a node with no ownership in the new epoch receives no sync gossip
+        for nid in sorted(new_top.nodes()):
+            assert cluster.node(nid).topology.is_sync_complete(new_top.epoch), \
+                f"node {nid} never saw epoch {new_top.epoch} sync"
+
+    def test_departed_replica_not_read(self):
+        """After leaving, the old replica no longer receives the shard's
+        writes (they flow to the new owner instead)."""
+        cluster = SimCluster(n_nodes=4, seed=64, n_shards=2, rf=3)
+        run_txn(cluster, 1, rw_txn([], {5: 0}))
+        old_shard = cluster.topology.shard_for_token(5)
+        leave = old_shard.nodes[0]
+        new_top = swap_replica(cluster.topology, 5, leave, 4)
+        cluster.update_topology(new_top)
+        cluster.process_all()
+        run_txn(cluster, 2, rw_txn([], {5: 1}))
+        cluster.process_all()
+        assert cluster.node(4).data_store.get(Key(5)) == (0, 1)
+        # the departed node stops at (a prefix of) the pre-change history —
+        # its in-flight Apply of write 0 may have raced the hand-off
+        assert cluster.node(leave).data_store.get(Key(5)) in ((), (0,))
+
+
+class TestSplitMergeFastpath:
+    def test_split_preserves_operation(self):
+        cluster = SimCluster(n_nodes=3, seed=65, n_shards=1)
+        run_txn(cluster, 1, rw_txn([], {100: 0}))
+        top = cluster.topology
+        s = top.shards[0]
+        mid = (s.range.start + s.range.end) // 2
+        new_top = Topology(top.epoch + 1, [
+            Shard(Range(s.range.start, mid), s.nodes),
+            Shard(Range(mid, s.range.end), s.nodes)])
+        cluster.update_topology(new_top)
+        run_txn(cluster, 2, rw_txn([], {100: 1}))
+        cluster.process_all()
+        r = run_txn(cluster, 3, rw_txn([100], {}))
+        assert r.read_values[Key(100)] == (0, 1)
+
+    def test_fastpath_electorate_change(self):
+        cluster = SimCluster(n_nodes=3, seed=66, n_shards=1)
+        top = cluster.topology
+        s = top.shards[0]
+        new_top = Topology(top.epoch + 1, [
+            Shard(s.range, s.nodes,
+                  fast_path_electorate=frozenset(list(s.nodes)[:2]))])
+        cluster.update_topology(new_top)
+        run_txn(cluster, 1, rw_txn([], {7: 0}))
+        cluster.process_all()
+        r = run_txn(cluster, 2, rw_txn([7], {}))
+        assert r.read_values[Key(7)] == (0,)
+
+
+class TestBurnWithTopologyChanges:
+    @pytest.mark.parametrize("seed", [600, 601])
+    def test_burn_churn(self, seed):
+        run = BurnRun(seed, ops=150, nodes=5, keys=12, n_shards=4, rf=3,
+                      topology_period_s=1.5)
+        stats = run.run()
+        assert stats.acks > 0
+        assert run.cluster.topology.epoch > 1, "nemesis never fired"
+
+    def test_burn_churn_with_drops(self):
+        run = BurnRun(602, ops=150, nodes=5, keys=12, n_shards=2, rf=3,
+                      drop_prob=0.05, topology_period_s=2.0)
+        stats = run.run()
+        assert stats.acks > 0
