@@ -176,10 +176,19 @@ class CommandsForKey:
 
     # -- the deps scan (mapReduceActive, CommandsForKey.java:614-650) --
     def _prune_bound(self, before: Timestamp):
-        """The max committed WRITE below `before` (by executeAt): every
-        decided txn it witnesses that executes before it is transitively
-        covered by depending on it (the reference's pruning below the max
-        committed write, CommandsForKey.java:614-650)."""
+        """The max committed WRITE started AND executing below `before`:
+        every decided txn it witnesses that executes before it is
+        transitively covered by depending on it (the reference's pruning
+        below the max committed write, CommandsForKey.java:614-650).
+
+        BOTH bounds matter. The cover argument is: dependent D (deps
+        bounded by `before` = D's executeAt) waits on the bound W*, and W*
+        waits on the pruned txn t, so t applies before D everywhere. A
+        committed write whose executeAt was bumped ABOVE `before` is ordered
+        after D — D's WaitingOn drops it ("not our problem") — so it covers
+        nothing for D; choosing it as the bound silently dropped t from D's
+        execution order (burn seed 7 drop 0.1: recovered txn pruned behind a
+        later-executing bound, read missed its write)."""
         bound_id = None
         bound_at = None
         for t in self._ids:
@@ -189,6 +198,8 @@ class CommandsForKey:
             if not info.status.is_committed:
                 continue
             at = info.execute_at_or_txn_id()
+            if at >= before:
+                continue  # executes after the querying txn: cannot cover
             if bound_at is None or at > bound_at:
                 bound_at, bound_id = at, t
         return bound_id, bound_at

@@ -35,8 +35,7 @@ def should_cleanup(store, cmd) -> Cleanup:
         # pass them (nobody can resurrect a lower ballot)
         participants = _participants(store, cmd)
         if participants is not None and _fully(
-                store.durable_before.is_universally_durable, cmd.txn_id,
-                participants):
+                store, "universal", cmd.txn_id, participants):
             return Cleanup.ERASE
         return Cleanup.NO
     if not cmd.has_been(SaveStatus.APPLIED):
@@ -44,13 +43,11 @@ def should_cleanup(store, cmd) -> Cleanup:
     participants = _participants(store, cmd)
     if participants is None:
         return Cleanup.NO
-    if _fully(store.durable_before.is_universally_durable, cmd.txn_id,
-              participants):
+    if _fully(store, "universal", cmd.txn_id, participants):
         # every replica of this shard applied it; peers of other shards ask
         # their own shard for the outcome — nothing can need ours again
         return Cleanup.ERASE
-    if _fully(store.durable_before.is_majority_durable, cmd.txn_id,
-              participants):
+    if _fully(store, "majority", cmd.txn_id, participants):
         return Cleanup.TRUNCATE_WITH_OUTCOME
     return Cleanup.NO
 
@@ -71,17 +68,24 @@ def _participants(store, cmd):
     return sliced if len(sliced) > 0 else None
 
 
-def _fully(pred, txn_id: TxnId, participants) -> bool:
+def _fully(store, which: str, txn_id: TxnId, participants) -> bool:
+    """Is txn_id durable at `which` tier across ALL of `participants`?
+
+    For Ranges this folds the piecewise DurableBefore map over every span
+    intersecting each range (DurableBefore.min: uncovered spans floor the
+    bound to NONE), so an interior span with a lower/no durable bound blocks
+    cleanup — endpoint probing missed those (ADVICE r1, high)."""
+    db = store.durable_before
     if isinstance(participants, Ranges):
         if participants.is_empty:
             return False
-        # probe both edges of every range (bounds are range-mapped)
-        from accord_tpu.primitives.keys import RoutingKey
-        return all(pred(txn_id, RoutingKey(r.start))
-                   and pred(txn_id, RoutingKey(r.end - 1))
-                   for r in participants)
+        majority, universal = db.min_bounds(participants)
+        bound = universal if which == "universal" else majority
+        return txn_id < bound
     if len(participants) == 0:
         return False
+    pred = (db.is_universally_durable if which == "universal"
+            else db.is_majority_durable)
     return all(pred(txn_id, k) for k in participants)
 
 
@@ -103,12 +107,19 @@ def sweep(store) -> int:
         C.purge(safe, txn_id, erase=decision == Cleanup.ERASE,
                 keep_outcome=decision == Cleanup.TRUNCATE_WITH_OUTCOME)
         purged += 1
-        if txn_id in store.range_commands:
+        # the range-conflict index entry may only be dropped once the shard
+        # fence guarantees no lower-id straggler can newly commit and rely on
+        # witnessing this txn (universal tier installs the fence); at the
+        # majority tier the command truncates but stays witnessable
+        if decision == Cleanup.ERASE and txn_id in store.range_commands:
             del store.range_commands[txn_id]
-    # prune conflict indexes below each key's majority bound: everything
-    # below it is decided and reconstructible from a majority elsewhere
+    # prune conflict indexes below each key's shard-applied fence: the fence
+    # ESP witnessed everything below it on every replica AND preaccept refuses
+    # lower-id stragglers, so nothing pruned can be needed by a new deps calc.
+    # (Majority durability alone is NOT enough: a low-id straggler the fence
+    # never saw could still commit and miss the pruned entries — ADVICE r1.)
     for key, cfk in store.cfks.items():
-        bound = store.durable_before.majority_before(key)
+        bound = store.redundant_before.shard_applied_before(key)
         if bound.hlc > 0:
             cfk.prune_redundant(bound)
     return purged

@@ -103,10 +103,9 @@ def is_shard_fenced(safe_store: SafeCommandStore, txn_id: TxnId,
     (RedundantBefore.shardAppliedOrInvalidatedBefore gating)."""
     rb = safe_store.store.redundant_before
     if isinstance(participants, Ranges):
-        from accord_tpu.primitives.keys import RoutingKey
-        return any(rb.is_shard_redundant(txn_id, RoutingKey(r.start))
-                   or rb.is_shard_redundant(txn_id, RoutingKey(r.end - 1))
-                   for r in participants)
+        # fold every intersecting fence span — an interior fenced sub-range
+        # must refuse the straggler even when the endpoints are unfenced
+        return rb.is_any_shard_redundant(txn_id, participants)
     return any(rb.is_shard_redundant(txn_id, k) for k in participants)
 
 
@@ -274,6 +273,8 @@ def commit(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
 
     cmd.stable_deps = deps
     cmd.set_status(SaveStatus.STABLE)
+    # stable deps in hand: any staleness-escalation counter is moot
+    safe_store.store.insufficient_catchups.pop(txn_id, None)
     safe_store.update_max_conflicts(
         cmd.partial_txn.keys if cmd.partial_txn is not None
         else route.participants(), execute_at)
@@ -323,6 +324,7 @@ def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId) -> None:
     if cmd.is_invalidated:
         return
     cmd.save_status = SaveStatus.INVALIDATED
+    safe_store.store.insufficient_catchups.pop(txn_id, None)
     safe_store.register(cmd, InternalStatus.INVALID_OR_TRUNCATED)
     safe_store.progress_log.clear(txn_id)
     _notify_listeners(safe_store, cmd)
@@ -357,6 +359,7 @@ def apply(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
     cmd.writes = writes
     cmd.result = result
     cmd.set_status(SaveStatus.PRE_APPLIED)
+    safe_store.store.insufficient_catchups.pop(txn_id, None)
     safe_store.progress_log.update(safe_store.store, txn_id, cmd)
     maybe_execute(safe_store, cmd, always_notify=True)
     return ApplyOutcome.SUCCESS
@@ -441,16 +444,16 @@ def _is_redundant_dep(safe_store: SafeCommandStore, cmd: Command,
             key_parts = dep.route.participants()
         else:
             return False
-    from accord_tpu.primitives.keys import RoutingKey
     if key_parts is not None:
         for k in key_parts:
             if not rb.is_redundant(dep_id, k):
                 return False
     if range_parts is not None and not range_parts.is_empty:
-        for r in range_parts:
-            if not (rb.is_redundant(dep_id, RoutingKey(r.start))
-                    and rb.is_redundant(dep_id, RoutingKey(r.end - 1))):
-                return False
+        # every span intersecting the dep ranges must be covered AND
+        # redundant — an interior never-bootstrapped sub-range keeps the
+        # dependency live there (ADVICE r1: endpoint probes missed it)
+        if not rb.is_all_redundant(dep_id, range_parts):
+            return False
     return True
 
 

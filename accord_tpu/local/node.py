@@ -91,6 +91,11 @@ class Node:
         self._hlc = 0
         self.coordinating: Dict[TxnId, AsyncResult] = {}
         self._reply_seq = 0
+        # spans with a staleness-escalation bootstrap in flight (dedup), and
+        # spans that re-escalated while covered by an in-flight attempt
+        # (needing a fresh fence once it completes)
+        self._stale_bootstrapping: Ranges = Ranges.EMPTY
+        self._stale_requeue: Ranges = Ranges.EMPTY
 
     # ------------------------------------------------------------ lifecycle --
     def on_topology_update(self, topology: Topology, start_sync: bool = True
@@ -129,6 +134,36 @@ class Node:
         for to in sorted(topology.nodes()):
             if to != self.id:
                 self.send(to, EpochSyncComplete(epoch))
+
+    def mark_stale_and_bootstrap(self, ranges: Ranges) -> None:
+        """Re-acquire `ranges` wholesale after local per-txn catch-up proved
+        impossible (peers truncated the deps): the staleness escalation path
+        (reference Agent.onStale / markShardStale -> Bootstrap).
+
+        Spans already being bootstrapped are not dropped — the in-flight
+        attempt's ESP fence may PREDATE the txn that just wedged (its
+        snapshot will not contain it), so they are queued and re-escalated
+        with a fresh fence once the in-flight attempt finishes."""
+        overlapping = ranges.slice(self._stale_bootstrapping)
+        if not overlapping.is_empty:
+            self._stale_requeue = self._stale_requeue.union(overlapping)
+        remaining = ranges.subtract(self._stale_bootstrapping)
+        if remaining.is_empty:
+            return
+        self._stale_bootstrapping = self._stale_bootstrapping.union(remaining)
+        self.agent.on_stale(self.unique_now(), remaining)
+        from accord_tpu.local.bootstrap import Bootstrap
+        attempt = Bootstrap(self, remaining, self.epoch)
+        attempt.result.add_callback(
+            lambda v, f: self._stale_bootstrap_done(remaining))
+        attempt.start()
+
+    def _stale_bootstrap_done(self, finished: Ranges) -> None:
+        self._stale_bootstrapping = self._stale_bootstrapping.subtract(finished)
+        requeue = self._stale_requeue.slice(finished)
+        if not requeue.is_empty:
+            self._stale_requeue = self._stale_requeue.subtract(requeue)
+            self.mark_stale_and_bootstrap(requeue)
 
     def progress_log_for(self, store) -> ProgressLog:
         pl = self._progress_logs.get(store.id)

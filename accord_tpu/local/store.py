@@ -159,8 +159,12 @@ class SafeCommandStore:
                                kinds: KindSet) -> bool:
         cmd = self.store.commands.get(txn_id)
         if cmd is None or cmd.save_status == SaveStatus.INVALIDATED \
-                or cmd.is_truncated:
+                or cmd.save_status == SaveStatus.ERASED:
             return False
+        # TRUNCATED_APPLY (majority-durable, outcome retained) remains a
+        # conflict: a lower-id straggler must still witness it so lagging
+        # replicas order their writes after it; only ERASE (universal tier,
+        # shard fence installed) removes it from witnessing entirely
         return txn_id < before and txn_id.kind in kinds
 
     def map_reduce_active(self, participants, before: Timestamp,
@@ -342,6 +346,9 @@ class CommandStore:
         from collections import deque
         self.notify_queue = deque()
         self.notifying = False
+        # per-txn count of failed catch-ups where every peer had truncated
+        # the deps (Propagate INSUFFICIENT): drives staleness escalation
+        self.insufficient_catchups: Dict[TxnId, int] = {}
 
     # -- environment plumbing --
     @property

@@ -130,6 +130,37 @@ class RedundantBefore:
         e = self._entry_for_key(key)
         return e is not None and txn_id < e.shard_applied_before
 
+    def shard_applied_before(self, key: RoutingKey) -> TxnId:
+        """The shard-applied fence at `key` (NONE when no fact recorded)."""
+        e = self._entry_for_key(key)
+        return e.shard_applied_before if e is not None else TXNID_NONE
+
+    def is_any_shard_redundant(self, txn_id: TxnId, ranges: Ranges) -> bool:
+        """Does ANY span intersecting `ranges` place txn_id below the
+        shard-applied fence? Folds every intersecting map span — an interior
+        fenced span must be seen even when the range endpoints are not fenced
+        (RedundantBefore fold semantics, not endpoint probing)."""
+        def f(acc, v):
+            return acc or (v is not None and txn_id < v.shard_applied_before)
+
+        return any(self._map.fold_intersecting(r.start, r.end, f, False)
+                   for r in ranges)
+
+    def is_all_redundant(self, txn_id: TxnId, ranges: Ranges) -> bool:
+        """Is txn_id below the locally-applied/bootstrap watermark on EVERY
+        span intersecting `ranges`? Uncovered (None) spans are NOT redundant:
+        an interior sub-range with no bootstrap/applied fact must keep the
+        dependency live there (ADVICE r1: endpoint probes missed interiors)."""
+        if ranges.is_empty:
+            return False
+
+        def f(acc, v):
+            return acc and v is not None and txn_id < max(
+                v.locally_applied_before, v.bootstrapped_at)
+
+        return all(self._map.fold_intersecting(r.start, r.end, f, True)
+                   for r in ranges)
+
     def pre_bootstrap_or_stale(self, txn_id: TxnId, participants
                                ) -> PreBootstrapOrStale:
         """Is txn_id before the bootstrap fence / within a stale window for

@@ -64,15 +64,37 @@ class _DepsAppliedWaiter(TransientListener):
     truncated locally (the ephemeral analogue of WaitingOn, without a
     Command record to hang it on)."""
 
-    def __init__(self, safe_store, dep_ids: List[TxnId], on_ready):
+    def __init__(self, safe_store, dep_ids: List[TxnId], on_ready,
+                 deps: "Deps" = None):
         self.on_ready = on_ready
         self.pending: Set[TxnId] = set()
         self.fired = False
+        # deps this wait created empty NOT_DEFINED records for — removed
+        # again once the wait resolves, so the store is not polluted by
+        # commands that exist purely to hang a listener on
+        self.created: Set[TxnId] = set()
         for dep_id in dep_ids:
-            cmd = safe_store.get(dep_id)
+            existing = safe_store.if_present(dep_id)
+            cmd = existing if existing is not None else safe_store.get(dep_id)
             if not self._cleared(safe_store, cmd):
+                if existing is None:
+                    self.created.add(dep_id)
                 self.pending.add(dep_id)
                 cmd.add_transient_listener(self)
+                # a dep this replica hasn't committed/applied may never
+                # arrive on its own (the Apply could be lost): register a
+                # progress-log chase so the missing state is fetched rather
+                # than the read hanging until the coordinator times out
+                # (the reference ReadData registers the same waiting intent)
+                if not cmd.has_been(SaveStatus.PRE_APPLIED):
+                    participants = None
+                    if deps is not None:
+                        key_parts, range_parts = deps.participants(dep_id)
+                        participants = key_parts if len(key_parts) > 0 \
+                            else range_parts
+                    safe_store.progress_log.waiting(
+                        dep_id, safe_store.store, "Applied", cmd.route,
+                        participants)
         if not self.pending:
             self.fired = True
             on_ready()
@@ -95,16 +117,31 @@ class _DepsAppliedWaiter(TransientListener):
         if self._cleared(safe_store, command):
             self.pending.discard(command.txn_id)
             command.remove_transient_listener(self)
+            self._maybe_drop_created(safe_store, command)
             if not self.pending:
                 self.fired = True
                 self.on_ready()
+
+    def _maybe_drop_created(self, safe_store, command) -> None:
+        """Remove a record that exists purely because this wait created it:
+        still NOT_DEFINED (it cleared via truncation/redundancy watermarks,
+        not by progressing) and nothing else is listening."""
+        if command.txn_id in self.created \
+                and command.save_status == SaveStatus.NOT_DEFINED \
+                and not command.transient_listeners \
+                and not command.listeners:
+            safe_store.store.commands.pop(command.txn_id, None)
+            # the chase existed for this wait; the store forgot the record,
+            # so stop fetching it too
+            safe_store.progress_log.clear(command.txn_id)
 
 
 def wait_for_deps_applied(safe_store, deps: Deps, on_ready) -> None:
     """Arrange `on_ready` once every locally-owned dep in `deps` has applied."""
     local = deps.slice(safe_store.ranges) if not safe_store.ranges.is_empty \
         else deps
-    _DepsAppliedWaiter(safe_store, local.sorted_txn_ids(), on_ready)
+    _DepsAppliedWaiter(safe_store, local.sorted_txn_ids(), on_ready,
+                       deps=local)
 
 
 class ReadEphemeralTxnData(TxnRequest):

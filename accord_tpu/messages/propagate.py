@@ -58,11 +58,15 @@ class Propagate(TxnRequest):
             if outcome == C.ApplyOutcome.INSUFFICIENT:
                 # truncated-with-outcome source (deps purged) and we are
                 # below STABLE: per-txn catch-up cannot order this write
-                # safely. The replica stays lagging until range bootstrap
-                # (DataStore.fetch) heals it wholesale — applying here with
-                # fabricated deps could reorder writes under the data
-                # plane's executeAt guard and diverge the replica.
-                pass
+                # safely — applying here with fabricated deps could reorder
+                # writes under the data plane's executeAt guard. After
+                # repeated failures, declare the owning ranges stale and
+                # re-acquire them wholesale (reference markShardStale ->
+                # bootstrap; ADVICE r1: nothing else triggers bootstrap
+                # outside topology changes, so the replica wedged forever).
+                self._maybe_escalate_staleness(safe_store, route)
+            else:
+                safe_store.store.insufficient_catchups.pop(self.txn_id, None)
             return SimpleReply(SimpleReply.OK)
         if k.save_status >= SaveStatus.STABLE and k.execute_at is not None \
                 and deps is not None and not cmd.has_been(SaveStatus.STABLE):
@@ -84,6 +88,34 @@ class Propagate(TxnRequest):
             C.preaccept(safe_store, self.txn_id, local, route)
             return SimpleReply(SimpleReply.OK)
         return SimpleReply(SimpleReply.OK)
+
+    STALE_AFTER_ATTEMPTS = 3
+
+    def _maybe_escalate_staleness(self, safe_store, route: Route) -> None:
+        """After repeated INSUFFICIENT catch-ups, mark the owning ranges stale
+        and drive a bootstrap fetch for them (Agent.onStale / markShardStale
+        -> Bootstrap in the reference)."""
+        store = safe_store.store
+        count = store.insufficient_catchups.get(self.txn_id, 0) + 1
+        store.insufficient_catchups[self.txn_id] = count
+        if count < self.STALE_AFTER_ATTEMPTS:
+            return
+        store.insufficient_catchups.pop(self.txn_id, None)
+        covering = route.covering() if route is not None else None
+        if covering is None or covering.is_empty:
+            return
+        owned = covering.slice(store.ranges) \
+            if not store.ranges.is_empty else covering
+        if owned.is_empty:
+            return
+        stale_until = self.known.execute_at if self.known.execute_at \
+            is not None else self.txn_id
+        store.redundant_before.set_stale_until(owned, stale_until)
+        # a stale span must nack reads immediately (coordinator retries a
+        # healthy peer) rather than let them hang on never-applying deps;
+        # Bootstrap._finish restores safe_to_read once the snapshot lands
+        store.safe_to_read = store.safe_to_read.subtract(owned)
+        safe_store.node.mark_stale_and_bootstrap(owned)
 
     def reduce(self, a, b):
         return a

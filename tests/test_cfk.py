@@ -1,0 +1,110 @@
+"""CommandsForKey unit tests — the per-key conflict index.
+
+Reference model: accord/local/CommandsForKey.java (mapReduceActive :614-650,
+recovery predicates :553-612).
+"""
+
+from accord_tpu.local.cfk import CommandsForKey, InternalStatus
+from accord_tpu.primitives.keys import Key
+from accord_tpu.primitives.timestamp import Domain, Timestamp, TxnId, TxnKind
+
+
+def wid(hlc: int, node: int = 1) -> TxnId:
+    return TxnId.create(1, hlc, TxnKind.WRITE, Domain.KEY, node)
+
+
+def ts(hlc: int, node: int = 1) -> Timestamp:
+    return Timestamp(1, hlc, 0, node)
+
+
+def active(cfk, before, kinds=None, deps_of=None):
+    out = []
+    kinds = kinds if kinds is not None else wid(0).kind.witnesses()
+    cfk.map_reduce_active(before, kinds, out.append, deps_of=deps_of)
+    return out
+
+
+class FakeDeps:
+    def __init__(self, ids):
+        self.ids = set(ids)
+
+    def contains(self, t):
+        return t in self.ids
+
+
+class TestMapReduceActive:
+    def test_includes_lower_ids(self):
+        cfk = CommandsForKey(Key(1))
+        a, b = wid(10), wid(20)
+        cfk.update(a, InternalStatus.PREACCEPTED)
+        cfk.update(b, InternalStatus.PREACCEPTED)
+        assert active(cfk, wid(30)) == [a, b]
+        assert active(cfk, wid(15)) == [a]
+
+    def test_excludes_invalidated(self):
+        cfk = CommandsForKey(Key(1))
+        a = wid(10)
+        cfk.update(a, InternalStatus.INVALID_OR_TRUNCATED)
+        assert active(cfk, wid(30)) == []
+
+    def test_transitive_prune_through_bound(self):
+        """A decided txn covered by the bound write's deps is pruned; the
+        bound itself stays."""
+        cfk = CommandsForKey(Key(1))
+        t_old = wid(10)
+        bound = wid(20)
+        cfk.update(t_old, InternalStatus.APPLIED, execute_at=ts(10))
+        cfk.update(bound, InternalStatus.STABLE, execute_at=ts(20))
+        deps = {bound: FakeDeps([t_old])}
+        out = active(cfk, wid(30), deps_of=deps.get)
+        assert out == [bound]
+
+    def test_unwitnessed_txn_not_pruned(self):
+        """Containment matters: the bound never witnessed t -> t stays."""
+        cfk = CommandsForKey(Key(1))
+        t_old = wid(10)
+        bound = wid(20)
+        cfk.update(t_old, InternalStatus.APPLIED, execute_at=ts(10))
+        cfk.update(bound, InternalStatus.STABLE, execute_at=ts(20))
+        deps = {bound: FakeDeps([])}
+        out = active(cfk, wid(30), deps_of=deps.get)
+        assert out == [t_old, bound]
+
+    def test_bound_executing_after_query_cannot_cover(self):
+        """Regression (burn seed 7, drop 0.1): a committed write whose
+        executeAt was bumped ABOVE the querying txn is ordered after it —
+        the dependent drops it from WaitingOn, so it covers nothing. Using
+        it as the prune bound silently dropped a recovered txn from the
+        execution order and a read missed its write."""
+        cfk = CommandsForKey(Key(1))
+        t_mid = wid(15)       # recovered txn, executes at its own ts
+        late = wid(12)        # started earlier but slow-pathed PAST before
+        cfk.update(t_mid, InternalStatus.STABLE, execute_at=ts(15))
+        cfk.update(late, InternalStatus.STABLE, execute_at=ts(40))
+        deps = {late: FakeDeps([t_mid]), t_mid: FakeDeps([])}
+        out = active(cfk, ts(30), deps_of=deps.get)
+        # late executes after ts(30): may not be chosen as prune bound, so
+        # t_mid must remain a direct dependency (t_mid itself is the bound)
+        assert t_mid in out
+
+    def test_prune_bound_is_max_write_executing_before(self):
+        cfk = CommandsForKey(Key(1))
+        w1, w2, w3 = wid(10), wid(12), wid(14)
+        cfk.update(w1, InternalStatus.APPLIED, execute_at=ts(10))
+        cfk.update(w2, InternalStatus.STABLE, execute_at=ts(25))
+        cfk.update(w3, InternalStatus.STABLE, execute_at=ts(50))
+        bound_id, bound_at = cfk._prune_bound(ts(30))
+        assert bound_id == w2 and bound_at == ts(25)
+        bound_id, _ = cfk._prune_bound(ts(20))
+        assert bound_id == w1
+
+
+class TestPruneRedundant:
+    def test_drops_terminal_below_bound(self):
+        cfk = CommandsForKey(Key(1))
+        a, b, c = wid(10), wid(20), wid(30)
+        cfk.update(a, InternalStatus.APPLIED, execute_at=ts(10))
+        cfk.update(b, InternalStatus.STABLE, execute_at=ts(20))
+        cfk.update(c, InternalStatus.APPLIED, execute_at=ts(30))
+        cfk.prune_redundant(wid(25))
+        assert cfk.all_ids() == [b, c]  # b not terminal, c above bound

@@ -1,0 +1,75 @@
+"""Interior-span regression tests for the piecewise watermark maps.
+
+ADVICE r1: endpoint-only probes of DurableBefore / RedundantBefore missed
+interior spans with lower (or no) bounds. These tests pin the fold-over-all-
+intersecting-spans semantics (reference ReducingRangeMap folds,
+DurableBefore.min / RedundantBefore classification).
+"""
+
+from accord_tpu.local.watermarks import DurableBefore, RedundantBefore
+from accord_tpu.primitives.keys import Ranges
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind, TXNID_NONE
+
+
+def tid(hlc: int) -> TxnId:
+    return TxnId.create(1, hlc, TxnKind.WRITE, Domain.KEY, 1)
+
+
+class TestDurableBeforeInteriorSpans:
+    def test_uncovered_interior_floors_min_bounds(self):
+        db = DurableBefore()
+        # durable on [0,10) and [20,30), nothing on the interior [10,20)
+        db.update(Ranges.of((0, 10)), tid(100), tid(100))
+        db.update(Ranges.of((20, 30)), tid(100), tid(100))
+        maj, uni = db.min_bounds(Ranges.of((0, 30)))
+        assert maj == TXNID_NONE and uni == TXNID_NONE
+
+    def test_lower_interior_bound_floors_min_bounds(self):
+        db = DurableBefore()
+        db.update(Ranges.of((0, 30)), tid(5), tid(5))
+        db.update(Ranges.of((0, 10)), tid(100), tid(100))
+        db.update(Ranges.of((20, 30)), tid(100), tid(100))
+        maj, uni = db.min_bounds(Ranges.of((0, 30)))
+        assert maj == tid(5) and uni == tid(5)
+
+    def test_fully_covered_min_bounds(self):
+        db = DurableBefore()
+        db.update(Ranges.of((0, 30)), tid(100), tid(50))
+        maj, uni = db.min_bounds(Ranges.of((5, 25)))
+        assert maj == tid(100) and uni == tid(50)
+
+
+class TestRedundantBeforeInteriorSpans:
+    def test_interior_fence_is_seen_by_any_probe(self):
+        rb = RedundantBefore()
+        # shard fence only on the interior [10,20); endpoints unfenced
+        rb.update_shard_applied(Ranges.of((10, 20)), tid(100))
+        assert rb.is_any_shard_redundant(tid(50), Ranges.of((0, 30)))
+        assert not rb.is_any_shard_redundant(tid(200), Ranges.of((0, 30)))
+        assert not rb.is_any_shard_redundant(tid(50), Ranges.of((20, 30)))
+
+    def test_uncovered_interior_blocks_all_redundant(self):
+        rb = RedundantBefore()
+        rb.update_locally_applied(Ranges.of((0, 10)), tid(100))
+        rb.update_locally_applied(Ranges.of((20, 30)), tid(100))
+        # interior [10,20) has no applied/bootstrap fact: NOT redundant there
+        assert not rb.is_all_redundant(tid(50), Ranges.of((0, 30)))
+        assert rb.is_all_redundant(tid(50), Ranges.of((0, 10)))
+
+    def test_interior_lower_bound_blocks_all_redundant(self):
+        rb = RedundantBefore()
+        rb.update_locally_applied(Ranges.of((0, 30)), tid(10))
+        rb.update_locally_applied(Ranges.of((0, 10)), tid(100))
+        rb.update_locally_applied(Ranges.of((20, 30)), tid(100))
+        assert not rb.is_all_redundant(tid(50), Ranges.of((0, 30)))
+        assert rb.is_all_redundant(tid(5), Ranges.of((0, 30)))
+
+    def test_bootstrap_counts_as_redundant_cover(self):
+        rb = RedundantBefore()
+        rb.set_bootstrapped_at(Ranges.of((0, 30)), tid(100))
+        assert rb.is_all_redundant(tid(50), Ranges.of((5, 25)))
+
+    def test_empty_ranges_not_redundant(self):
+        rb = RedundantBefore()
+        rb.update_locally_applied(Ranges.of((0, 30)), tid(100))
+        assert not rb.is_all_redundant(tid(50), Ranges.EMPTY)
