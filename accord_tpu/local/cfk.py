@@ -1,9 +1,28 @@
 """CommandsForKey: the per-key conflict index — north-star kernel #1.
 
-Reference: accord/local/CommandsForKey.java:132 (TxnInfo :194-293, the
-mapReduceActive deps scan :614-650, mapReduceFull recovery queries :553-612,
-incremental update :652, Unmanaged registrations :140-184,1270) and
-accord/impl/TimestampsForKey.java:33.
+Reference: accord/local/CommandsForKey.java:132 (design doc :74-131, TxnInfo
+:194-293, the missing[] divergence encoding :412-470, mapReduceActive deps
+scan :614-650, mapReduceFull recovery queries :553-612, incremental update
+with missing maintenance :652-1000, Unmanaged registrations :140-184).
+
+Representation (the reference's packed TxnInfo[] re-designed as parallel
+arrays, which is also the zero-copy device format for accord_tpu.ops):
+
+  _ids[i]      sorted TxnIds — every globally-visible key-domain txn witnessed
+               at this key that is not shard-redundant
+  _status[i]   InternalStatus (compressed per-key view)
+  _eat[i]      executeAt, or None meaning "executes at its own TxnId"
+  _missing[i]  sorted tuple of TxnIds DIVERGING from the implied deps, or ()
+
+The collection IMPLIES deps: a command with known deps (status.has_info) is
+assumed to depend on every id in the collection below its depsKnownBefore
+that its kind witnesses; only divergences are stored, in missing[i]. Ids
+recorded COMMITTED-or-higher are elided from every missing collection (a
+recovery coordinator that sees the committed status never needs to decipher
+fast-path votes for it, CommandsForKey.java:82-88).
+
+A committed-by-executeAt view (_committed) drives execution-order queries and
+the transitive-dependency elision in map_reduce_active.
 
 Host-side scalar implementation; the batched device equivalent (one XLA call
 computing deps for a whole window of transactions) lives in
@@ -13,17 +32,17 @@ accord_tpu.ops.deps_kernel and must stay bit-identical to this path.
 from __future__ import annotations
 
 import enum
-from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+from bisect import bisect_left, bisect_right, insort
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from accord_tpu.primitives.keys import Key
 from accord_tpu.primitives.timestamp import Timestamp, TxnId, TxnKind, KindSet
 from accord_tpu.utils import invariants
-from accord_tpu.utils.sorted_arrays import find_ceil
 
 
 class InternalStatus(enum.IntEnum):
     """Compressed per-key view of a command's state
-    (CommandsForKey.InternalStatus, CommandsForKey.java:194)."""
+    (CommandsForKey.InternalStatus, CommandsForKey.java:194-236)."""
 
     TRANSITIVELY_KNOWN = 0   # known only via deps; never witnessed directly
     HISTORICAL = 1
@@ -44,23 +63,53 @@ class InternalStatus(enum.IntEnum):
 
     @property
     def is_terminal(self) -> bool:
-        return self in (InternalStatus.APPLIED, InternalStatus.INVALID_OR_TRUNCATED)
+        return self in (InternalStatus.APPLIED,
+                        InternalStatus.INVALID_OR_TRUNCATED)
+
+    @property
+    def has_info(self) -> bool:
+        """Deps (and a meaningful executeAt) are recorded from ACCEPTED on
+        (InternalStatus.hasInfo): these are the entries whose missing[]
+        answers recovery's dep-membership tests."""
+        return InternalStatus.ACCEPTED <= self <= InternalStatus.APPLIED
+
+
+class TestStartedAt(enum.Enum):
+    STARTED_BEFORE = "STARTED_BEFORE"
+    STARTED_AFTER = "STARTED_AFTER"
+    ANY = "ANY"
+
+
+class TestDep(enum.Enum):
+    WITH = "WITH"
+    WITHOUT = "WITHOUT"
+    ANY_DEPS = "ANY_DEPS"
+
+
+class TestStatus(enum.Enum):
+    ANY_STATUS = "ANY_STATUS"
+    IS_PROPOSED = "IS_PROPOSED"   # ACCEPTED or COMMITTED
+    IS_STABLE = "IS_STABLE"       # STABLE..APPLIED
 
 
 class TxnInfo:
-    __slots__ = ("txn_id", "status", "execute_at", "ballot_accepted")
+    """Materialised view of one entry (the packed arrays are authoritative)."""
+
+    __slots__ = ("txn_id", "status", "execute_at", "missing")
 
     def __init__(self, txn_id: TxnId, status: InternalStatus,
-                 execute_at: Optional[Timestamp] = None):
+                 execute_at: Optional[Timestamp], missing: Tuple[TxnId, ...]):
         self.txn_id = txn_id
         self.status = status
         self.execute_at = execute_at
+        self.missing = missing
 
     def execute_at_or_txn_id(self) -> Timestamp:
         return self.execute_at if self.execute_at is not None else self.txn_id
 
     def __repr__(self):
-        return f"TxnInfo({self.txn_id!r}, {self.status.name}, at={self.execute_at!r})"
+        return (f"TxnInfo({self.txn_id!r}, {self.status.name}, "
+                f"at={self.execute_at!r}, missing={self.missing!r})")
 
 
 class Unmanaged:
@@ -81,59 +130,192 @@ class Unmanaged:
         self.callback = callback
 
 
-class CommandsForKey:
-    """All transactions witnessed at one key, ordered by TxnId, with a
-    committed-by-executeAt view for execution ordering."""
+def _deps_known_before(txn_id: TxnId, status: InternalStatus,
+                       execute_at: Optional[Timestamp]) -> Timestamp:
+    """The bound below which this entry's deps are complete
+    (InternalStatus.depsKnownBefore): txnId until commit, executeAt after."""
+    if status.is_committed and execute_at is not None:
+        return execute_at
+    return txn_id
 
-    __slots__ = ("key", "_by_id", "_ids", "_unmanaged", "redundant_before")
+
+class CommandsForKey:
+    """All transactions witnessed at one key, ordered by TxnId, with the
+    missing[] divergence encoding and a committed-by-executeAt view."""
+
+    __slots__ = ("key", "_ids", "_status", "_eat", "_missing", "_committed",
+                 "_unmanaged", "redundant_before")
 
     def __init__(self, key: Key):
         self.key = key
-        self._by_id: Dict[TxnId, TxnInfo] = {}
-        self._ids: List[TxnId] = []          # sorted
+        self._ids: List[TxnId] = []
+        self._status: List[InternalStatus] = []
+        self._eat: List[Optional[Timestamp]] = []
+        self._missing: List[Tuple[TxnId, ...]] = []
+        # (executeAt, txn_id) sorted, for entries COMMITTED..APPLIED
+        self._committed: List[Tuple[Timestamp, TxnId]] = []
         self._unmanaged: List[Unmanaged] = []
         self.redundant_before: Optional[TxnId] = None
 
-    # -- maintenance --
+    # ------------------------------------------------------------ plumbing --
+    def _pos(self, txn_id: TxnId) -> int:
+        """Index of txn_id, or -(insert_pos)-1 if absent."""
+        i = bisect_left(self._ids, txn_id)
+        if i < len(self._ids) and self._ids[i] == txn_id:
+            return i
+        return -i - 1
+
+    def _eat_of(self, i: int) -> Timestamp:
+        e = self._eat[i]
+        return e if e is not None else self._ids[i]
+
+    def _committed_add(self, txn_id: TxnId, at: Timestamp) -> None:
+        insort(self._committed, (at, txn_id))
+
+    def _committed_remove(self, txn_id: TxnId, at: Timestamp) -> None:
+        i = bisect_left(self._committed, (at, txn_id))
+        if i < len(self._committed) and self._committed[i] == (at, txn_id):
+            del self._committed[i]
+
+    # -------------------------------------------------------- maintenance --
     def update(self, txn_id: TxnId, status: InternalStatus,
-               execute_at: Optional[Timestamp] = None) -> None:
+               execute_at: Optional[Timestamp] = None,
+               dep_ids: Optional[Sequence[TxnId]] = None) -> None:
         """Incremental maintenance on a command transition
-        (CommandsForKey.update, :652)."""
-        info = self._by_id.get(txn_id)
-        if info is None:
-            info = TxnInfo(txn_id, status, execute_at)
-            self._by_id[txn_id] = info
-            i = find_ceil(self._ids, txn_id)
-            self._ids.insert(i, txn_id)
-        else:
-            # per-key status only advances (monotone view of the command;
-            # INVALID_OR_TRUNCATED is the maximum so it always applies)
-            if status < info.status:
+        (CommandsForKey.update, :652-770 + the insert/update helpers).
+
+        `dep_ids` — the command's key-domain dependency TxnIds AT THIS KEY
+        (from its partial/stable deps), required to compute the missing[]
+        divergence when `status.has_info`; ignored otherwise.
+        """
+        pos = self._pos(txn_id)
+        if pos >= 0:
+            cur = self._status[pos]
+            if status < cur:
+                return  # per-key view is monotone
+            if status == cur and not status.has_info:
                 return
-            info.status = status
+            was_committed = cur.is_committed
+            if was_committed and status.is_committed \
+                    and execute_at is not None \
+                    and self._eat_of(pos) != execute_at:
+                # executeAt is fixed at commit; keep the committed view exact
+                self._committed_remove(txn_id, self._eat_of(pos))
+                self._committed_add(txn_id, execute_at)
+            self._status[pos] = status
             if execute_at is not None:
-                info.execute_at = execute_at
+                self._eat[pos] = None if execute_at == txn_id else execute_at
+            if status.is_committed and not was_committed:
+                self._committed_add(txn_id, self._eat_of(self._pos(txn_id)))
+            if status == InternalStatus.INVALID_OR_TRUNCATED and was_committed:
+                self._committed_remove(txn_id, self._eat_of(pos))
+            if status.is_decided and not (cur.is_decided):
+                # newly Committed-or-higher: elide from all missing[]
+                self._remove_missing(txn_id)
+        else:
+            insert_at = -pos - 1
+            self._insert(insert_at, txn_id, status, execute_at)
+            if status.is_committed:
+                self._committed_add(txn_id, self._eat_of(self._pos(txn_id)))
+
+        if status.has_info and dep_ids is not None:
+            self._apply_deps(txn_id, status, dep_ids)
+
         if status.is_committed or status == InternalStatus.INVALID_OR_TRUNCATED:
             self._notify_unmanaged()
 
+    def _insert(self, i: int, txn_id: TxnId, status: InternalStatus,
+                execute_at: Optional[Timestamp]) -> None:
+        self._ids.insert(i, txn_id)
+        self._status.insert(i, status)
+        self._eat.insert(i, None if execute_at is None or execute_at == txn_id
+                         else execute_at)
+        self._missing.insert(i, ())
+        if not status.is_decided:
+            # every existing entry with known deps whose bound should have
+            # witnessed this id did not (it was unknown until now): record
+            # the divergence (insertInfoAndOneMissing, :897-960)
+            self._add_missing_everywhere(txn_id)
+
+    def _add_missing_everywhere(self, new_id: TxnId) -> None:
+        for j in range(len(self._ids)):
+            if self._ids[j] == new_id or not self._status[j].has_info:
+                continue
+            bound = _deps_known_before(self._ids[j], self._status[j],
+                                       self._eat[j])
+            if bound > new_id and self._ids[j].witnesses(new_id):
+                m = self._missing[j]
+                k = bisect_left(m, new_id)
+                if k >= len(m) or m[k] != new_id:
+                    self._missing[j] = m[:k] + (new_id,) + m[k:]
+
+    def _remove_missing(self, txn_id: TxnId) -> None:
+        """Elide a newly-committed id from every missing collection
+        (removeMissing, :962-987)."""
+        for j in range(len(self._missing)):
+            m = self._missing[j]
+            if not m:
+                continue
+            k = bisect_left(m, txn_id)
+            if k < len(m) and m[k] == txn_id:
+                self._missing[j] = m[:k] + m[k + 1:]
+
+    def _apply_deps(self, txn_id: TxnId, status: InternalStatus,
+                    dep_ids: Sequence[TxnId]) -> None:
+        """Install the entry's own missing[] divergence and insert any dep
+        ids not yet witnessed here (the additions path, :738-860)."""
+        dep_set = set(dep_ids)
+        # additions: deps referencing ids this key has never witnessed
+        additions = sorted(t for t in dep_set
+                           if t.is_key_domain and self._pos(t) < 0)
+        for t in additions:
+            i = -self._pos(t) - 1
+            self._insert(i, t, InternalStatus.TRANSITIVELY_KNOWN, None)
+        pos = self._pos(txn_id)
+        bound = _deps_known_before(txn_id, status, self._eat[pos])
+        missing: List[TxnId] = []
+        hi = bisect_left(self._ids, bound)
+        for j in range(hi):
+            t = self._ids[j]
+            if t == txn_id or t in dep_set:
+                continue
+            if self._status[j].is_decided:
+                continue  # elided: recovery sees the committed status
+            if txn_id.witnesses(t):
+                missing.append(t)
+        self._missing[pos] = tuple(missing)
+
     def register_historical(self, txn_id: TxnId) -> None:
-        """Witness a txn known only transitively (registerHistorical)."""
-        if txn_id not in self._by_id:
-            self.update(txn_id, InternalStatus.TRANSITIVELY_KNOWN)
+        """Witness a txn known only through another replica's deps
+        (registerHistorical)."""
+        if self._pos(txn_id) < 0:
+            self.update(txn_id, InternalStatus.HISTORICAL)
 
     def prune_redundant(self, before: TxnId) -> None:
         """Drop applied/invalidated txns below the redundancy watermark."""
         self.redundant_before = (before if self.redundant_before is None
                                  else max(self.redundant_before, before))
-        keep = [t for t in self._ids
-                if not (t < before and self._by_id[t].status.is_terminal)]
-        for t in set(self._ids) - set(keep):
-            del self._by_id[t]
-        self._ids = keep
+        drop = [i for i, t in enumerate(self._ids)
+                if t < before and self._status[i].is_terminal]
+        if not drop:
+            return
+        dropped = {self._ids[i] for i in drop}
+        for i in reversed(drop):
+            if self._status[i].is_committed:
+                self._committed_remove(self._ids[i], self._eat_of(i))
+            del self._ids[i], self._status[i], self._eat[i], self._missing[i]
+        for j in range(len(self._missing)):
+            m = self._missing[j]
+            if m and any(t in dropped for t in m):
+                self._missing[j] = tuple(t for t in m if t not in dropped)
 
-    # -- introspection --
+    # ------------------------------------------------------ introspection --
     def get(self, txn_id: TxnId) -> Optional[TxnInfo]:
-        return self._by_id.get(txn_id)
+        i = self._pos(txn_id)
+        if i < 0:
+            return None
+        return TxnInfo(self._ids[i], self._status[i], self._eat[i],
+                       self._missing[i])
 
     def size(self) -> int:
         return len(self._ids)
@@ -141,172 +323,170 @@ class CommandsForKey:
     def all_ids(self) -> List[TxnId]:
         return list(self._ids)
 
+    def as_arrays(self):
+        """The packed representation, for the device encoder: parallel
+        (ids, status, execute_at_or_txn_id, missing) sequences."""
+        return (self._ids, self._status,
+                [self._eat_of(i) for i in range(len(self._ids))],
+                self._missing)
+
     def min_uncommitted(self) -> Optional[TxnId]:
-        for t in self._ids:
-            if not self._by_id[t].status.is_decided:
+        for i, t in enumerate(self._ids):
+            if not self._status[i].is_decided:
                 return t
         return None
 
     def max_committed_write_at(self) -> Optional[Timestamp]:
-        best: Optional[Timestamp] = None
-        for t in self._ids:
-            info = self._by_id[t]
-            if info.status.is_committed and t.kind.is_write:
-                at = info.execute_at_or_txn_id()
-                best = at if best is None or at > best else best
-        return best
-
-    def max_applied_write_at(self) -> Optional[Timestamp]:
-        best: Optional[Timestamp] = None
-        for t in self._ids:
-            info = self._by_id[t]
-            if info.status == InternalStatus.APPLIED and t.kind.is_write:
-                at = info.execute_at_or_txn_id()
-                best = at if best is None or at > best else best
-        return best
+        for at, t in reversed(self._committed):
+            if t.kind.is_write:
+                return at
+        return None
 
     def max_conflict(self) -> Optional[Timestamp]:
         """Max (txnId | committed executeAt) at this key — executeAt proposal
         input."""
         best: Optional[Timestamp] = None
-        for t in self._ids:
-            at = self._by_id[t].execute_at_or_txn_id()
-            best = at if best is None or at > best else best
+        if self._ids:
+            best = self._ids[-1]
+        if self._committed and (best is None or self._committed[-1][0] > best):
+            best = self._committed[-1][0]
         return best
 
-    # -- the deps scan (mapReduceActive, CommandsForKey.java:614-650) --
-    def _prune_bound(self, before: Timestamp):
-        """The max committed WRITE started AND executing below `before`:
-        every decided txn it witnesses that executes before it is
-        transitively covered by depending on it (the reference's pruning
-        below the max committed write, CommandsForKey.java:614-650).
-
-        BOTH bounds matter. The cover argument is: dependent D (deps
-        bounded by `before` = D's executeAt) waits on the bound W*, and W*
-        waits on the pruned txn t, so t applies before D everywhere. A
-        committed write whose executeAt was bumped ABOVE `before` is ordered
-        after D — D's WaitingOn drops it ("not our problem") — so it covers
-        nothing for D; choosing it as the bound silently dropped t from D's
-        execution order (burn seed 7 drop 0.1: recovered txn pruned behind a
-        later-executing bound, read missed its write)."""
-        bound_id = None
-        bound_at = None
-        for t in self._ids:
-            if t >= before or not t.kind.is_write:
-                continue
-            info = self._by_id[t]
-            if not info.status.is_committed:
-                continue
-            at = info.execute_at_or_txn_id()
-            if at >= before:
-                continue  # executes after the querying txn: cannot cover
-            if bound_at is None or at > bound_at:
-                bound_at, bound_id = at, t
-        return bound_id, bound_at
+    # ------------- the deps scan (mapReduceActive, CommandsForKey.java:614) --
+    def max_committed_write_before(self, before: Timestamp
+                                   ) -> Optional[Timestamp]:
+        """Max executeAt among committed WRITES executing strictly before
+        `before` — the transitive-elision bound."""
+        i = bisect_left(self._committed, (before,))
+        i -= 1
+        while i >= 0 and not self._committed[i][1].kind.is_write:
+            i -= 1
+        return self._committed[i][0] if i >= 0 else None
 
     def map_reduce_active(self, before: Timestamp, kinds: KindSet,
                           fn: Callable[[TxnId], None],
-                          prune: bool = True,
-                          deps_of: Callable[[TxnId], object] = None) -> None:
+                          prune: bool = True) -> None:
         """Visit every active txn with txnId < `before` whose kind is in
         `kinds` — the dependency calculation for a new txn at this key.
 
-        'Active' excludes invalidated/truncated txns, those pruned as
-        redundant, and (when `prune` and `deps_of` is given) txns
-        *provably* covered by the max committed write W*: t is pruned iff
-        W*'s locally-known committed deps CONTAIN t and t is decided to
-        execute before W* — then depending on W* transitively orders us
-        after t. Keeping deps bounded this way is what stops dependency sets
-        growing without limit between durability sweeps. The containment
-        check matters: inferring coverage from timestamps alone can prune a
-        txn the bound never actually witnessed, silently dropping it from
-        the execution order (the reference tracks exact witnessing via the
-        per-txn missing[] arrays, CommandsForKey.java:412-420).
+        Transitive elision (mapReduceActive :614-650): establish the
+        last-executing committed write below `before`; any COMMITTED-or-later
+        txn with a lower executeAt is elided — its stable deps are complete,
+        so depending on the bound write transitively orders us after it; for
+        recovery, the committed status reported by this replica means no
+        fast-path deciphering will consult these deps (design doc :101-112).
+        TRANSITIVELY_KNOWN ids are unwitnessed (they exist only to track
+        missing[] divergence) and never become deps themselves.
         """
-        bound_id, bound_at = self._prune_bound(before) if prune \
-            else (None, None)
-        bound_deps = deps_of(bound_id) \
-            if bound_id is not None and deps_of is not None else None
-        hi = find_ceil(self._ids, before)
+        bound = self.max_committed_write_before(before) if prune else None
+        hi = bisect_left(self._ids, before)
         for i in range(hi):
             t = self._ids[i]
-            info = self._by_id[t]
-            if info.status == InternalStatus.INVALID_OR_TRUNCATED:
-                continue
             if t.kind not in kinds:
                 continue
-            if bound_deps is not None and t != bound_id \
-                    and info.status.is_decided \
-                    and info.execute_at_or_txn_id() < bound_at \
-                    and bound_deps.contains(t):
-                continue  # provably covered by the bound write
+            st = self._status[i]
+            if st == InternalStatus.TRANSITIVELY_KNOWN \
+                    or st == InternalStatus.INVALID_OR_TRUNCATED:
+                continue
+            if st.is_committed and bound is not None \
+                    and self._eat_of(i) < bound:
+                continue  # transitively covered by the bound write
             fn(t)
 
-    # -- recovery queries (mapReduceFull, CommandsForKey.java:553-612) --
-    def committed_executes_after_without_witnessing(
-            self, txn_id: TxnId, witnessed_by: Callable[[TxnId], bool]) -> bool:
-        """Any STABLE-or-later txn executing after txn_id whose deps omit it?
-        (rejectsFastPath input: hasStableExecutesAfterWithoutWitnessing)"""
-        for t in self._ids:
-            info = self._by_id[t]
-            if (InternalStatus.STABLE <= info.status <= InternalStatus.APPLIED
-                    and info.execute_at_or_txn_id() > txn_id
-                    and t.witnesses(txn_id) and not witnessed_by(t)):
-                return True
-        return False
+    # ------------- recovery queries (mapReduceFull, CommandsForKey.java:553) --
+    def map_reduce_full(self, test_txn_id: TxnId, kinds: KindSet,
+                        test_started_at: TestStartedAt, test_dep: TestDep,
+                        test_status: TestStatus,
+                        fn: Callable[[TxnId, Timestamp], None]) -> None:
+        """The recovery query family. Dep tests consult the missing[]
+        divergence encoding: an entry with known deps (has_info) and
+        executeAt > test_txn_id has test_txn_id as a dependency iff it is
+        NOT listed in its missing collection (:598-608)."""
+        pos = self._pos(test_txn_id)
+        is_known = pos >= 0
+        if not is_known and test_dep == TestDep.WITH:
+            return
+        insert_pos = pos if is_known else -pos - 1
+        if test_started_at == TestStartedAt.STARTED_BEFORE:
+            start, end = 0, insert_pos
+        elif test_started_at == TestStartedAt.STARTED_AFTER:
+            start, end = insert_pos, len(self._ids)
+        else:
+            start, end = 0, len(self._ids)
 
-    def accepted_or_committed_started_after_without_witnessing(
-            self, txn_id: TxnId, witnessed_by: Callable[[TxnId], bool]) -> bool:
-        """Any ACCEPTED+ txn with txnId > txn_id whose deps omit it?
-        (rejectsFastPath input)"""
-        lo = find_ceil(self._ids, txn_id)
-        for i in range(lo, len(self._ids)):
+        for i in range(start, end):
             t = self._ids[i]
-            if t == txn_id:
+            if t == test_txn_id or t.kind not in kinds:
                 continue
-            info = self._by_id[t]
-            if InternalStatus.ACCEPTED <= info.status <= InternalStatus.APPLIED \
-                    and t.witnesses(txn_id) and not witnessed_by(t):
-                return True
-        return False
+            st = self._status[i]
+            if test_status == TestStatus.IS_PROPOSED:
+                if st not in (InternalStatus.ACCEPTED,
+                              InternalStatus.COMMITTED):
+                    continue
+            elif test_status == TestStatus.IS_STABLE:
+                if not (InternalStatus.STABLE <= st
+                        <= InternalStatus.APPLIED):
+                    continue
+            else:
+                if st == InternalStatus.TRANSITIVELY_KNOWN:
+                    continue
+            execute_at = self._eat_of(i)
+            if test_dep != TestDep.ANY_DEPS:
+                if not st.has_info:
+                    continue
+                if execute_at <= test_txn_id:
+                    continue
+                m = self._missing[i]
+                k = bisect_left(m, test_txn_id)
+                has_as_dep = not (k < len(m) and m[k] == test_txn_id)
+                if has_as_dep != (test_dep == TestDep.WITH):
+                    continue
+            fn(t, execute_at)
 
-    def stable_started_before_and_witnessed(
-            self, txn_id: TxnId, witnessed_by: Callable[[TxnId], bool]
-    ) -> List[TxnId]:
-        """STABLE+ txns with txnId < txn_id that DID witness it
-        (earlierCommittedWitness: evidence the fast path was taken)."""
-        hi = find_ceil(self._ids, txn_id)
-        out = []
-        for i in range(hi):
-            t = self._ids[i]
-            info = self._by_id[t]
-            if info.status >= InternalStatus.STABLE \
-                    and info.status != InternalStatus.INVALID_OR_TRUNCATED \
-                    and witnessed_by(t):
-                out.append(t)
+    # the four BeginRecovery predicates (BeginRecovery.java:329-380)
+    def accepted_or_committed_started_after_without_witnessing(
+            self, txn_id: TxnId) -> bool:
+        found = []
+        self.map_reduce_full(txn_id, txn_id.kind.witnessed_by(),
+                             TestStartedAt.STARTED_AFTER, TestDep.WITHOUT,
+                             TestStatus.IS_PROPOSED,
+                             lambda t, at: found.append(t))
+        return bool(found)
+
+    def committed_executes_after_without_witnessing(self, txn_id: TxnId
+                                                    ) -> bool:
+        """hasStableExecutesAfterWithoutWitnessing (ANY started-at; the dep
+        test already restricts to executeAt > txn_id)."""
+        found = []
+        self.map_reduce_full(txn_id, txn_id.kind.witnessed_by(),
+                             TestStartedAt.ANY, TestDep.WITHOUT,
+                             TestStatus.IS_STABLE,
+                             lambda t, at: found.append(t))
+        return bool(found)
+
+    def stable_started_before_and_witnessed(self, txn_id: TxnId
+                                            ) -> List[TxnId]:
+        out: List[TxnId] = []
+        self.map_reduce_full(txn_id, txn_id.kind.witnessed_by(),
+                             TestStartedAt.STARTED_BEFORE, TestDep.WITH,
+                             TestStatus.IS_STABLE,
+                             lambda t, at: out.append(t))
         return out
 
-    def accepted_started_before_without_witnessing(
-            self, txn_id: TxnId, witnessed_by: Callable[[TxnId], bool]
-    ) -> List[TxnId]:
-        """ACCEPTED (deps still *proposed*, not yet committed) txns with
-        txnId < txn_id, proposed to execute after txn_id, whose deps omit it
-        (earlierAcceptedNoWitness: recovery must await their commit before
-        deciphering the fast path — BeginRecovery.java:329-342, TestStatus
-        IS_PROPOSED + executeAt > startedBefore filter; once such a txn
-        commits it leaves this set, so the await/retry loop terminates)."""
-        hi = find_ceil(self._ids, txn_id)
-        out = []
-        for i in range(hi):
-            t = self._ids[i]
-            info = self._by_id[t]
-            if info.status == InternalStatus.ACCEPTED \
-                    and info.execute_at_or_txn_id() > txn_id \
-                    and txn_id.witnesses(t) and not witnessed_by(t):
-                out.append(t)
+    def accepted_started_before_without_witnessing(self, txn_id: TxnId
+                                                   ) -> List[TxnId]:
+        """acceptedOrCommittedStartedBeforeWithoutWitnessing: proposed to
+        execute after txn_id with deps omitting it — recovery must await
+        their commit before deciphering the fast path (:329-342)."""
+        out: List[TxnId] = []
+        self.map_reduce_full(txn_id, txn_id.kind.witnessed_by(),
+                             TestStartedAt.STARTED_BEFORE, TestDep.WITHOUT,
+                             TestStatus.IS_PROPOSED,
+                             lambda t, at: out.append(t) if at > txn_id
+                             else None)
         return out
 
-    # -- unmanaged (cross-key) waits --
+    # ---------------------------------------- unmanaged (cross-key) waits --
     def register_unmanaged(self, unmanaged: Unmanaged) -> None:
         self._unmanaged.append(unmanaged)
         self._notify_unmanaged()
@@ -326,19 +506,19 @@ class CommandsForKey:
             u.callback()
 
     def _unmanaged_satisfied(self, u: Unmanaged) -> bool:
-        for t in self._ids:
+        for i, t in enumerate(self._ids):
             if t >= u.waiting_until or t == u.txn_id:
                 continue
-            info = self._by_id[t]
-            if not t.is_visible:
+            st = self._status[i]
+            if not t.is_visible or st == InternalStatus.TRANSITIVELY_KNOWN:
                 continue
             if u.pending == Unmanaged.COMMIT:
-                if not info.status.is_decided:
+                if not st.is_decided:
                     return False
             else:  # APPLY
-                if not info.status.is_terminal:
-                    if not (info.status.is_committed
-                            and info.execute_at_or_txn_id() > u.waiting_until):
+                if not st.is_terminal:
+                    if not (st.is_committed
+                            and self._eat_of(i) > u.waiting_until):
                         return False
         return True
 

@@ -132,11 +132,21 @@ class SafeCommandStore:
 
     def register(self, command: Command, status: InternalStatus) -> None:
         """Reflect a command transition into every owned CFK
-        (SafeCommandStore registration / CommandsForKey.update)."""
+        (SafeCommandStore registration / CommandsForKey.update). When the
+        transition carries deps (ACCEPTED+), each key's CFK receives the
+        command's dependency ids AT THAT KEY so it can maintain the
+        missing[] divergence encoding."""
         if command.txn_id.is_range_domain:
             return  # range txns are tracked via rangeCommands, not per-key CFK
+        deps = None
+        if status.has_info:
+            deps = command.stable_deps if command.stable_deps is not None \
+                else command.partial_deps
         for key in self.owned_keys_of(command):
-            self.cfk(key).update(command.txn_id, status, command.execute_at)
+            dep_ids = deps.key_deps.txn_ids_for_key(key) \
+                if deps is not None else None
+            self.cfk(key).update(command.txn_id, status, command.execute_at,
+                                 dep_ids=dep_ids)
 
     def register_range_txn(self, command: Command, ranges: Ranges) -> None:
         self.store.range_commands[command.txn_id] = ranges.slice(self.ranges) \
@@ -184,20 +194,11 @@ class SafeCommandStore:
         owned = self._owned_participants(participants)
         keys = self._owned_cfk_keys(owned) if is_range else owned
 
-        def deps_of(txn_id: TxnId):
-            """Committed deps of a local command, for transitive pruning."""
-            cmd = self.store.commands.get(txn_id)
-            if cmd is None:
-                return None
-            return cmd.stable_deps if cmd.stable_deps is not None \
-                else cmd.partial_deps
-
         for key in keys:
             cfk = self.store.cfks.get(key)
             if cfk is not None:
                 cfk.map_reduce_active(before, kinds,
-                                      lambda t, k=key: fn(k, t),
-                                      deps_of=deps_of)
+                                      lambda t, k=key: fn(k, t))
         # range-domain txns intersecting the participants are conflicts too
         for txn_id, ranges in self.store.range_commands.items():
             if not self._active_range_conflict(txn_id, before, kinds):
@@ -264,9 +265,9 @@ class SafeCommandStore:
     def rejects_fast_path(self, txn_id: TxnId, participants) -> bool:
         wb = lambda t: self._witnessed_by(t, txn_id)
         for cfk in self._participant_cfks(participants):
-            if cfk.accepted_or_committed_started_after_without_witnessing(txn_id, wb):
+            if cfk.accepted_or_committed_started_after_without_witnessing(txn_id):
                 return True
-            if cfk.committed_executes_after_without_witnessing(txn_id, wb):
+            if cfk.committed_executes_after_without_witnessing(txn_id):
                 return True
         for cmd, _ in self._conflicting_range_cmds(txn_id, participants):
             if not cmd.txn_id.witnesses(txn_id) or wb(cmd.txn_id) \
@@ -287,7 +288,7 @@ class SafeCommandStore:
         builder = KeyDeps.builder()
         rbuilder = RangeDeps.builder()
         for cfk in self._participant_cfks(participants):
-            for t in cfk.stable_started_before_and_witnessed(txn_id, wb):
+            for t in cfk.stable_started_before_and_witnessed(txn_id):
                 builder.add(cfk.key, t)
         for cmd, overlap in self._conflicting_range_cmds(txn_id, participants):
             if cmd.txn_id < txn_id and cmd.has_been(SaveStatus.STABLE) \
@@ -303,8 +304,7 @@ class SafeCommandStore:
         builder = KeyDeps.builder()
         rbuilder = RangeDeps.builder()
         for cfk in self._participant_cfks(participants):
-            for t in cfk.accepted_started_before_without_witnessing(
-                    txn_id, wb):
+            for t in cfk.accepted_started_before_without_witnessing(txn_id):
                 builder.add(cfk.key, t)
         for cmd, overlap in self._conflicting_range_cmds(txn_id, participants):
             if cmd.txn_id < txn_id \

@@ -6,14 +6,18 @@ CommandsForKey.mapReduceActive (reference accord/local/CommandsForKey.java:
 614-650, driven per-shard by messages/PreAccept.java:245-266).
 
 Device formulation over the rank encoding (ops/encode.py):
-    dep[b, e] = touches[b, key(e)]            # txn b reads/writes entry e's key
-              & rank(e) < rank(b)             # entry started before txn b
-              & witnesses(kind(b), kind(e))   # txn-kind conflict matrix
-              & status(e) != INVALID          # active (not invalidated/pruned)
-The whole [B, E] tile is one fused broadcast-compare on the VPU; XLA fuses
-the gather + three compares + reduction into a single pass over HBM.  The
-in-batch conflict graph (for the wavefront resolver) is one bf16 matmul on
-the MXU: share[b, b'] = touches @ touches.T > 0.
+    base[b, e] = touches[b, key(e)]           # txn b reads/writes entry e's key
+               & rank(e) < rank(b)            # entry started before txn b
+               & witnesses(kind(b), kind(e))  # txn-kind conflict matrix
+               & status(e) in 1..6            # not TRANSITIVELY_KNOWN/INVALID
+Transitive elision (the reference's pruning below the max committed write):
+    bound[b, k] = max eat_rank over committed WRITE entries at key k with
+                  eat_rank < rank(b)          # scatter-max over the key axis
+    dep[b, e]  = base[b, e] & ~(committed(e) & eat_rank(e) < bound[b, key(e)])
+The [B, E] tile is fused broadcast-compares on the VPU plus one scatter-max
+and one gather; XLA fuses the lot into a single pass over HBM.  The in-batch
+conflict graph (for the wavefront resolver) is one matmul on the MXU:
+share[b, b'] = touches @ touches.T > 0.
 """
 
 from __future__ import annotations
@@ -23,20 +27,44 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from accord_tpu.ops.encode import STATUS_INACTIVE
+from accord_tpu.ops.encode import STATUS_INACTIVE, WRITE_KIND
+
+# InternalStatus numeric bands (accord_tpu.local.cfk.InternalStatus)
+_TRANSITIVELY_KNOWN = 0
+_COMMITTED = 4
+_APPLIED = 6
 
 
-@functools.partial(jax.jit, static_argnames=())
-def batched_active_deps(entry_rank: jax.Array, entry_key: jax.Array,
-                        entry_status: jax.Array, entry_kind: jax.Array,
+@functools.partial(jax.jit, static_argnames=("num_keys",))
+def batched_active_deps(entry_rank: jax.Array, entry_eat_rank: jax.Array,
+                        entry_key: jax.Array, entry_status: jax.Array,
+                        entry_kind: jax.Array,
                         txn_rank: jax.Array, txn_witness_mask: jax.Array,
-                        touches: jax.Array):
+                        touches: jax.Array, *, num_keys: int = 0):
     """-> (dep_mask[B, E] bool, dep_count[B] i32 — per-(txn,key) edges)."""
+    k = touches.shape[1] if num_keys == 0 else num_keys
     touch_e = jnp.take(touches, entry_key, axis=1)            # [B, E] gather
     earlier = entry_rank[None, :] < txn_rank[:, None]          # [B, E]
     witnessed = ((txn_witness_mask[:, None] >> entry_kind[None, :]) & 1) == 1
-    active = (entry_status != STATUS_INACTIVE) & (entry_rank >= 0)
-    dep = touch_e & earlier & witnessed & active[None, :]
+    active = (entry_rank >= 0) \
+        & (entry_status > _TRANSITIVELY_KNOWN) \
+        & (entry_status != STATUS_INACTIVE)
+    base = touch_e & earlier & witnessed & active[None, :]
+
+    # transitive elision bound: per (txn, key) the max executeAt rank among
+    # committed writes executing strictly before the querying txn
+    committed = (entry_status >= _COMMITTED) & (entry_status <= _APPLIED) \
+        & (entry_rank >= 0)
+    is_write = entry_kind == WRITE_KIND
+    exec_earlier = entry_eat_rank[None, :] < txn_rank[:, None]   # [B, E]
+    cand = jnp.where(committed[None, :] & is_write[None, :] & exec_earlier,
+                     entry_eat_rank[None, :], -1)                # [B, E]
+    bound_bk = jnp.full((touches.shape[0], k), -1, jnp.int32)
+    bound_bk = bound_bk.at[:, entry_key].max(cand)               # scatter-max
+    bound_be = jnp.take(bound_bk, entry_key, axis=1)             # [B, E]
+    elided = committed[None, :] & (entry_eat_rank[None, :] < bound_be)
+
+    dep = base & ~elided
     return dep, dep.sum(axis=1, dtype=jnp.int32)
 
 
@@ -46,11 +74,8 @@ def in_batch_graph(txn_rank: jax.Array, txn_witness_mask: jax.Array,
     """In-window conflict graph for the wavefront resolver.
 
     dep_bb[b, b'] = txns share a key & rank(b') < rank(b) & b witnesses b'.
-    The key-sharing test rides the MXU: touches @ touches.T in bf16 is exact
-    for key fan-outs < 256 (bf16 has an 8-bit mantissa; we only test > 0, and
-    any shared key contributes >= 1, so overflow cannot create false
-    negatives at realistic key counts; we use f32 to be exact regardless).
-    """
+    The key-sharing test rides the MXU: touches @ touches.T in f32, tested
+    > 0 (any shared key contributes >= 1)."""
     shared = jnp.dot(touches.astype(jnp.float32),
                      touches.astype(jnp.float32).T,
                      preferred_element_type=jnp.float32) > 0    # [B, B] MXU

@@ -1,18 +1,20 @@
 """Host <-> device encoding for the batched kernels.
 
 The device never sees 128-bit timestamps.  The host assembles the *universe*
-of TxnIds relevant to a batch window (every id in the per-key conflict
-indexes plus the batch's own ids), sorts it with full Timestamp order
-(epoch, hlc, flags, node — accord_tpu.primitives.timestamp), and ships dense
-int32 *ranks*.  Rank comparison on device is then bit-identical to Timestamp
-comparison on host, which is what makes the device path provably equivalent
-to the scalar scans (reference CommandsForKey.java:614-650 iterates ids in
-exactly this sorted order).
+of Timestamps relevant to a batch window (every TxnId in the per-key conflict
+indexes, every distinct executeAt, plus the batch's own ids), sorts it with
+full Timestamp order (epoch, hlc, flags, node — accord_tpu.primitives
+.timestamp), and ships dense int32 *ranks*.  Rank comparison on device is
+then bit-identical to Timestamp comparison on host, which is what makes the
+device path provably equivalent to the scalar scans (reference
+CommandsForKey.java:614-650 iterates ids in exactly this sorted order, and
+elides by executeAt against the max committed write).
 
 Layouts (all padded to lane multiples, pad entries are inert):
   DeviceState  — one row per (key, txn) conflict-index entry:
-      entry_rank[E] i32, entry_key[E] i32, entry_status[E] i32,
-      entry_kind[E] i32
+      entry_rank[E] i32     (TxnId rank; -1 = pad)
+      entry_eat_rank[E] i32 (executeAt-or-txnId rank)
+      entry_key[E] i32, entry_status[E] i32, entry_kind[E] i32
   DeviceBatch  — one row per new transaction in the window:
       txn_rank[B] i32, txn_witness_mask[B] i32 (bit k = witnesses TxnKind k),
       touches[B, K] bool
@@ -30,6 +32,7 @@ from accord_tpu.primitives.timestamp import TxnId, TxnKind
 
 PAD = 128
 STATUS_INACTIVE = int(InternalStatus.INVALID_OR_TRUNCATED)
+WRITE_KIND = int(TxnKind.WRITE)
 
 
 def _pad_to(n: int, pad: int) -> int:
@@ -39,13 +42,14 @@ def _pad_to(n: int, pad: int) -> int:
 class DeviceState:
     """Dense encoding of a set of per-key conflict indexes."""
 
-    __slots__ = ("entry_rank", "entry_key", "entry_status", "entry_kind",
-                 "num_entries", "num_keys")
+    __slots__ = ("entry_rank", "entry_eat_rank", "entry_key", "entry_status",
+                 "entry_kind", "num_entries", "num_keys")
 
-    def __init__(self, entry_rank: np.ndarray, entry_key: np.ndarray,
-                 entry_status: np.ndarray, entry_kind: np.ndarray,
-                 num_entries: int, num_keys: int):
+    def __init__(self, entry_rank: np.ndarray, entry_eat_rank: np.ndarray,
+                 entry_key: np.ndarray, entry_status: np.ndarray,
+                 entry_kind: np.ndarray, num_entries: int, num_keys: int):
         self.entry_rank = entry_rank
+        self.entry_eat_rank = entry_eat_rank
         self.entry_key = entry_key
         self.entry_status = entry_status
         self.entry_kind = entry_kind
@@ -75,6 +79,19 @@ def witness_mask(kind: TxnKind) -> int:
     return mask
 
 
+def collect_universe(cfks: Sequence[CommandsForKey],
+                     batch_ids: Sequence[TxnId]):
+    """The sorted Timestamp universe for one window: every entry id, every
+    distinct executeAt, every batch id. Returns (universe, rank)."""
+    ts = set(batch_ids)
+    for cfk in cfks:
+        ids, _status, eats, _missing = cfk.as_arrays()
+        ts.update(ids)
+        ts.update(eats)
+    universe = sorted(ts)
+    return universe, {t: i for i, t in enumerate(universe)}
+
+
 class BatchEncoder:
     """Encodes one flush window: conflict-index state + new txns -> arrays.
 
@@ -91,18 +108,15 @@ class BatchEncoder:
         self.key_index: Dict[Key, int] = {k: i for i, k in enumerate(self.keys)}
         self.batch = list(batch)
 
-        ids = set()
-        entries: List[Tuple[int, TxnId, InternalStatus]] = []
+        self.universe, self.rank = collect_universe(
+            cfks, [tid for tid, _ in batch])
+
+        entries: List[Tuple[int, TxnId, InternalStatus, object]] = []
         for cfk in cfks:
             ki = self.key_index[cfk.key]
-            for tid in cfk.all_ids():
-                info = cfk.get(tid)
-                entries.append((ki, tid, info.status))
-                ids.add(tid)
-        for tid, _ in batch:
-            ids.add(tid)
-        self.universe: List[TxnId] = sorted(ids)
-        self.rank: Dict[TxnId, int] = {t: i for i, t in enumerate(self.universe)}
+            ids, statuses, eats, _missing = cfk.as_arrays()
+            for tid, status, eat in zip(ids, statuses, eats):
+                entries.append((ki, tid, status, eat))
         self.entries = entries
 
         e = _pad_to(max(1, len(entries)), pad)
@@ -110,16 +124,19 @@ class BatchEncoder:
         b = _pad_to(max(1, len(batch)), pad)
 
         entry_rank = np.full(e, -1, np.int32)
+        entry_eat_rank = np.full(e, -1, np.int32)
         entry_key = np.zeros(e, np.int32)
         entry_status = np.full(e, STATUS_INACTIVE, np.int32)
         entry_kind = np.zeros(e, np.int32)
-        for i, (ki, tid, status) in enumerate(entries):
+        for i, (ki, tid, status, eat) in enumerate(entries):
             entry_rank[i] = self.rank[tid]
+            entry_eat_rank[i] = self.rank[eat]
             entry_key[i] = ki
             entry_status[i] = int(status)
             entry_kind[i] = int(tid.kind)
-        self.state = DeviceState(entry_rank, entry_key, entry_status,
-                                 entry_kind, len(entries), len(self.keys))
+        self.state = DeviceState(entry_rank, entry_eat_rank, entry_key,
+                                 entry_status, entry_kind,
+                                 len(entries), len(self.keys))
 
         txn_rank = np.full(b, -1, np.int32)
         txn_wmask = np.zeros(b, np.int32)
@@ -152,7 +169,7 @@ class BatchEncoder:
         for b in range(len(self.batch)):
             m: Dict[Key, List[TxnId]] = {}
             for e in np.nonzero(dep_mask[b][:len(self.entries)])[0]:
-                ki, tid, _ = self.entries[e]
+                ki, tid, _, _ = self.entries[e]
                 m.setdefault(self.keys[ki], []).append(tid)
             out.append({k: sorted(v) for k, v in m.items()})
         return out

@@ -38,11 +38,11 @@ from accord_tpu.primitives.timestamp import TxnId
 
 
 @functools.partial(jax.jit, static_argnames=())
-def resolve_step(entry_rank, entry_key, entry_status, entry_kind,
-                 txn_rank, txn_witness_mask, txn_kind, touches):
+def resolve_step(entry_rank, entry_eat_rank, entry_key, entry_status,
+                 entry_kind, txn_rank, txn_witness_mask, txn_kind, touches):
     """Single-device reference pipeline: deps + in-window graph + waves."""
     dep_mask, dep_count = batched_active_deps(
-        entry_rank, entry_key, entry_status, entry_kind,
+        entry_rank, entry_eat_rank, entry_key, entry_status, entry_kind,
         txn_rank, txn_witness_mask, touches)
     dep_bb = in_batch_graph(txn_rank, txn_witness_mask, txn_kind, touches)
     waves = execution_waves(dep_bb)
@@ -57,12 +57,13 @@ def make_sharded_step(mesh: Mesh, axis: str = "shard"):
     holding *local* key indices in [0, Ks).
     """
 
-    def _local(entry_rank, entry_key, entry_status, entry_kind,
-               txn_rank, txn_witness_mask, txn_kind, touches):
+    def _local(entry_rank, entry_eat_rank, entry_key, entry_status,
+               entry_kind, txn_rank, txn_witness_mask, txn_kind, touches):
         entry_rank, entry_key = entry_rank[0], entry_key[0]
+        entry_eat_rank = entry_eat_rank[0]
         entry_status, entry_kind = entry_status[0], entry_kind[0]
         dep_mask, dep_count_local = batched_active_deps(
-            entry_rank, entry_key, entry_status, entry_kind,
+            entry_rank, entry_eat_rank, entry_key, entry_status, entry_kind,
             txn_rank, txn_witness_mask, touches)
         dep_count = jax.lax.psum(dep_count_local, axis)
         tf = touches.astype(jnp.float32)
@@ -74,7 +75,7 @@ def make_sharded_step(mesh: Mesh, axis: str = "shard"):
 
     fn = shard_map(
         _local, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis),
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis),
                   P(), P(), P(), P(None, axis)),
         out_specs=(P(axis), P(), P(), P()))
     return jax.jit(fn)
@@ -96,12 +97,10 @@ class ShardedEncoder:
         self.n_shards = n_shards
         self.batch = list(batch)
         keys = sorted({c.key for c in cfks} | {k for _, ks in batch for k in ks})
-        ids = {tid for tid, _ in batch}
         per_key: Dict[Key, CommandsForKey] = {c.key: c for c in cfks}
-        for c in cfks:
-            ids.update(c.all_ids())
-        self.universe = sorted(ids)
-        self.rank = {t: i for i, t in enumerate(self.universe)}
+        from accord_tpu.ops.encode import collect_universe
+        self.universe, self.rank = collect_universe(
+            cfks, [tid for tid, _ in batch])
 
         # contiguous key blocks
         blocks: List[List[Key]] = [[] for _ in range(n_shards)]
@@ -110,27 +109,30 @@ class ShardedEncoder:
             blocks[min(i // max(1, per), n_shards - 1) if per else 0].append(k)
         self.blocks = blocks
         ks = _pad_to(max([1] + [len(b) for b in blocks]), pad)
-        entries_per: List[List[Tuple[int, TxnId, int]]] = []
+        entries_per: List[List[Tuple[int, TxnId, int, object]]] = []
         for s in range(n_shards):
-            es: List[Tuple[int, TxnId, int]] = []
+            es: List[Tuple[int, TxnId, int, object]] = []
             for li, k in enumerate(blocks[s]):
                 cfk = per_key.get(k)
                 if cfk is None:
                     continue
-                for tid in cfk.all_ids():
-                    es.append((li, tid, int(cfk.get(tid).status)))
+                ids, statuses, eats, _missing = cfk.as_arrays()
+                for tid, status, eat in zip(ids, statuses, eats):
+                    es.append((li, tid, int(status), eat))
             entries_per.append(es)
         es_pad = _pad_to(max([1] + [len(e) for e in entries_per]), pad)
 
         S = n_shards
         self.entry_rank = np.full((S, es_pad), -1, np.int32)
+        self.entry_eat_rank = np.full((S, es_pad), -1, np.int32)
         self.entry_key = np.zeros((S, es_pad), np.int32)
         self.entry_status = np.full((S, es_pad), STATUS_INACTIVE, np.int32)
         self.entry_kind = np.zeros((S, es_pad), np.int32)
         self.entries_per = entries_per
         for s, es in enumerate(entries_per):
-            for i, (li, tid, status) in enumerate(es):
+            for i, (li, tid, status, eat) in enumerate(es):
                 self.entry_rank[s, i] = self.rank[tid]
+                self.entry_eat_rank[s, i] = self.rank[eat]
                 self.entry_key[s, i] = li
                 self.entry_status[s, i] = status
                 self.entry_kind[s, i] = int(tid.kind)
@@ -153,9 +155,9 @@ class ShardedEncoder:
                 self.touches[i, key_slot[k]] = True
 
     def args(self):
-        return (self.entry_rank, self.entry_key, self.entry_status,
-                self.entry_kind, self.txn_rank, self.txn_witness_mask,
-                self.txn_kind, self.touches)
+        return (self.entry_rank, self.entry_eat_rank, self.entry_key,
+                self.entry_status, self.entry_kind, self.txn_rank,
+                self.txn_witness_mask, self.txn_kind, self.touches)
 
     def decode_deps(self, dep_mask: np.ndarray) -> List[List[TxnId]]:
         """[S, B, Es] (or [S*B?, ...]) stacked shard outputs -> sorted ids."""

@@ -1,10 +1,12 @@
 """CommandsForKey unit tests — the per-key conflict index.
 
-Reference model: accord/local/CommandsForKey.java (mapReduceActive :614-650,
-recovery predicates :553-612).
+Reference model: accord/local/CommandsForKey.java (design doc :74-131,
+missing[] maintenance :652-1000, mapReduceActive :614-650, mapReduceFull
+recovery queries :553-612).
 """
 
-from accord_tpu.local.cfk import CommandsForKey, InternalStatus
+from accord_tpu.local.cfk import (CommandsForKey, InternalStatus, TestDep,
+                                  TestStartedAt, TestStatus)
 from accord_tpu.primitives.keys import Key
 from accord_tpu.primitives.timestamp import Domain, Timestamp, TxnId, TxnKind
 
@@ -13,23 +15,19 @@ def wid(hlc: int, node: int = 1) -> TxnId:
     return TxnId.create(1, hlc, TxnKind.WRITE, Domain.KEY, node)
 
 
+def rid(hlc: int, node: int = 1) -> TxnId:
+    return TxnId.create(1, hlc, TxnKind.READ, Domain.KEY, node)
+
+
 def ts(hlc: int, node: int = 1) -> Timestamp:
     return Timestamp(1, hlc, 0, node)
 
 
-def active(cfk, before, kinds=None, deps_of=None):
+def active(cfk, before, kinds=None, prune=True):
     out = []
     kinds = kinds if kinds is not None else wid(0).kind.witnesses()
-    cfk.map_reduce_active(before, kinds, out.append, deps_of=deps_of)
+    cfk.map_reduce_active(before, kinds, out.append, prune=prune)
     return out
-
-
-class FakeDeps:
-    def __init__(self, ids):
-        self.ids = set(ids)
-
-    def contains(self, t):
-        return t in self.ids
 
 
 class TestMapReduceActive:
@@ -41,70 +39,220 @@ class TestMapReduceActive:
         assert active(cfk, wid(30)) == [a, b]
         assert active(cfk, wid(15)) == [a]
 
-    def test_excludes_invalidated(self):
+    def test_excludes_invalidated_and_transitive(self):
         cfk = CommandsForKey(Key(1))
-        a = wid(10)
+        a, b = wid(10), wid(12)
         cfk.update(a, InternalStatus.INVALID_OR_TRUNCATED)
+        cfk.update(b, InternalStatus.TRANSITIVELY_KNOWN)
         assert active(cfk, wid(30)) == []
 
-    def test_transitive_prune_through_bound(self):
-        """A decided txn covered by the bound write's deps is pruned; the
-        bound itself stays."""
+    def test_transitive_elision_below_committed_write(self):
+        """Committed txns executing before the max committed write below
+        `before` are elided; uncommitted ones are not."""
         cfk = CommandsForKey(Key(1))
-        t_old = wid(10)
+        old = wid(10)
+        pre = wid(12)
         bound = wid(20)
-        cfk.update(t_old, InternalStatus.APPLIED, execute_at=ts(10))
-        cfk.update(bound, InternalStatus.STABLE, execute_at=ts(20))
-        deps = {bound: FakeDeps([t_old])}
-        out = active(cfk, wid(30), deps_of=deps.get)
-        assert out == [bound]
-
-    def test_unwitnessed_txn_not_pruned(self):
-        """Containment matters: the bound never witnessed t -> t stays."""
-        cfk = CommandsForKey(Key(1))
-        t_old = wid(10)
-        bound = wid(20)
-        cfk.update(t_old, InternalStatus.APPLIED, execute_at=ts(10))
-        cfk.update(bound, InternalStatus.STABLE, execute_at=ts(20))
-        deps = {bound: FakeDeps([])}
-        out = active(cfk, wid(30), deps_of=deps.get)
-        assert out == [t_old, bound]
+        cfk.update(old, InternalStatus.APPLIED, execute_at=ts(10),
+                   dep_ids=[])
+        cfk.update(pre, InternalStatus.PREACCEPTED)
+        cfk.update(bound, InternalStatus.STABLE, execute_at=ts(20),
+                   dep_ids=[old, pre])
+        out = active(cfk, wid(30))
+        assert out == [pre, bound]          # old elided, uncommitted kept
+        assert active(cfk, wid(30), prune=False) == [old, pre, bound]
 
     def test_bound_executing_after_query_cannot_cover(self):
         """Regression (burn seed 7, drop 0.1): a committed write whose
-        executeAt was bumped ABOVE the querying txn is ordered after it —
-        the dependent drops it from WaitingOn, so it covers nothing. Using
-        it as the prune bound silently dropped a recovered txn from the
-        execution order and a read missed its write."""
+        executeAt was bumped ABOVE the query bound is ordered after the
+        querying txn — the dependent drops it from WaitingOn, so it covers
+        nothing and may not be the elision bound."""
         cfk = CommandsForKey(Key(1))
         t_mid = wid(15)       # recovered txn, executes at its own ts
         late = wid(12)        # started earlier but slow-pathed PAST before
-        cfk.update(t_mid, InternalStatus.STABLE, execute_at=ts(15))
-        cfk.update(late, InternalStatus.STABLE, execute_at=ts(40))
-        deps = {late: FakeDeps([t_mid]), t_mid: FakeDeps([])}
-        out = active(cfk, ts(30), deps_of=deps.get)
-        # late executes after ts(30): may not be chosen as prune bound, so
-        # t_mid must remain a direct dependency (t_mid itself is the bound)
+        cfk.update(t_mid, InternalStatus.STABLE, execute_at=ts(15),
+                   dep_ids=[late])
+        cfk.update(late, InternalStatus.STABLE, execute_at=ts(40),
+                   dep_ids=[])
+        out = active(cfk, ts(30))
         assert t_mid in out
 
-    def test_prune_bound_is_max_write_executing_before(self):
+    def test_elision_bound_is_max_write_executing_before(self):
         cfk = CommandsForKey(Key(1))
         w1, w2, w3 = wid(10), wid(12), wid(14)
-        cfk.update(w1, InternalStatus.APPLIED, execute_at=ts(10))
-        cfk.update(w2, InternalStatus.STABLE, execute_at=ts(25))
-        cfk.update(w3, InternalStatus.STABLE, execute_at=ts(50))
-        bound_id, bound_at = cfk._prune_bound(ts(30))
-        assert bound_id == w2 and bound_at == ts(25)
-        bound_id, _ = cfk._prune_bound(ts(20))
-        assert bound_id == w1
+        cfk.update(w1, InternalStatus.APPLIED, execute_at=ts(10), dep_ids=[])
+        cfk.update(w2, InternalStatus.STABLE, execute_at=ts(25),
+                   dep_ids=[w1])
+        cfk.update(w3, InternalStatus.STABLE, execute_at=ts(50),
+                   dep_ids=[w1, w2])
+        assert cfk.max_committed_write_before(ts(30)) == ts(25)
+        assert cfk.max_committed_write_before(ts(20)) == ts(10)
+        assert cfk.max_committed_write_before(ts(5)) is None
+
+
+class TestMissing:
+    def test_insert_below_records_divergence(self):
+        """A new txn inserted below an entry with known deps lands in that
+        entry's missing[] (its deps were fixed before the newcomer)."""
+        cfk = CommandsForKey(Key(1))
+        acc = wid(20)
+        cfk.update(acc, InternalStatus.ACCEPTED, execute_at=ts(20),
+                   dep_ids=[])
+        newcomer = wid(10)
+        cfk.update(newcomer, InternalStatus.PREACCEPTED)
+        assert cfk.get(acc).missing == (newcomer,)
+
+    def test_deps_containing_id_no_divergence(self):
+        cfk = CommandsForKey(Key(1))
+        dep = wid(10)
+        cfk.update(dep, InternalStatus.PREACCEPTED)
+        acc = wid(20)
+        cfk.update(acc, InternalStatus.ACCEPTED, execute_at=ts(20),
+                   dep_ids=[dep])
+        assert cfk.get(acc).missing == ()
+
+    def test_missing_computed_from_deps(self):
+        cfk = CommandsForKey(Key(1))
+        a, b = wid(10), wid(12)
+        cfk.update(a, InternalStatus.PREACCEPTED)
+        cfk.update(b, InternalStatus.PREACCEPTED)
+        acc = wid(20)
+        cfk.update(acc, InternalStatus.ACCEPTED, execute_at=ts(20),
+                   dep_ids=[b])          # witnessed b but not a
+        assert cfk.get(acc).missing == (a,)
+
+    def test_committed_ids_elided_from_missing(self):
+        cfk = CommandsForKey(Key(1))
+        a = wid(10)
+        cfk.update(a, InternalStatus.PREACCEPTED)
+        acc = wid(20)
+        cfk.update(acc, InternalStatus.ACCEPTED, execute_at=ts(20),
+                   dep_ids=[])
+        assert cfk.get(acc).missing == (a,)
+        # once a commits, recovery never deciphers its fast path: elide
+        cfk.update(a, InternalStatus.COMMITTED, execute_at=ts(10),
+                   dep_ids=[])
+        assert cfk.get(acc).missing == ()
+
+    def test_additions_inserted_as_transitively_known(self):
+        cfk = CommandsForKey(Key(1))
+        unseen = wid(5)
+        acc = wid(20)
+        cfk.update(acc, InternalStatus.ACCEPTED, execute_at=ts(20),
+                   dep_ids=[unseen])
+        info = cfk.get(unseen)
+        assert info is not None
+        assert info.status == InternalStatus.TRANSITIVELY_KNOWN
+        assert cfk.get(acc).missing == ()
+        # transitively-known ids are not deps themselves
+        assert unseen not in active(cfk, wid(30))
+
+    def test_read_not_witnessing_write_kinds(self):
+        """A READ's missing[] only tracks ids its kind witnesses (writes)."""
+        cfk = CommandsForKey(Key(1))
+        r_old = rid(10)
+        w_old = wid(12)
+        cfk.update(r_old, InternalStatus.PREACCEPTED)
+        cfk.update(w_old, InternalStatus.PREACCEPTED)
+        reader = rid(20)
+        cfk.update(reader, InternalStatus.ACCEPTED, execute_at=ts(20),
+                   dep_ids=[])
+        assert cfk.get(reader).missing == (w_old,)   # reads witness only Ws
+
+
+class TestMapReduceFull:
+    def _setup(self):
+        """target at 15; acc (started after, no witness), stab (stable,
+        witnessed), nowit (stable, no witness)."""
+        cfk = CommandsForKey(Key(1))
+        target = wid(15)
+        cfk.update(target, InternalStatus.PREACCEPTED)
+        acc = wid(20)
+        cfk.update(acc, InternalStatus.ACCEPTED, execute_at=ts(20),
+                   dep_ids=[])                      # missing: target
+        stab = wid(25)
+        cfk.update(stab, InternalStatus.STABLE, execute_at=ts(25),
+                   dep_ids=[target, acc])           # witnessed target
+        return cfk, target, acc, stab
+
+    def test_started_after_without_witnessing(self):
+        cfk, target, acc, stab = self._setup()
+        assert cfk.accepted_or_committed_started_after_without_witnessing(
+            target)
+        # stab witnessed it; once acc also witnesses, predicate clears
+        cfk.update(acc, InternalStatus.ACCEPTED, execute_at=ts(20),
+                   dep_ids=[target])
+        assert not cfk \
+            .accepted_or_committed_started_after_without_witnessing(target)
+
+    def test_stable_executes_after_without_witnessing(self):
+        cfk, target, acc, stab = self._setup()
+        assert not cfk.committed_executes_after_without_witnessing(target)
+        nowit = wid(30)
+        cfk.update(nowit, InternalStatus.STABLE, execute_at=ts(30),
+                   dep_ids=[acc, stab])             # omits target
+        assert cfk.committed_executes_after_without_witnessing(target)
+
+    def test_stable_started_before_and_witnessed(self):
+        """A stable txn with id < probe < its executeAt whose deps contain
+        the probe is fast-path evidence (earlierCommittedWitness). The dep
+        test only consults entries executing AFTER the probe — an entry
+        executing before it cannot have it as a dependency."""
+        cfk = CommandsForKey(Key(1))
+        probe = wid(22)
+        cfk.update(probe, InternalStatus.PREACCEPTED)
+        stab = wid(20)
+        cfk.update(stab, InternalStatus.STABLE, execute_at=ts(35),
+                   dep_ids=[probe])
+        assert cfk.stable_started_before_and_witnessed(probe) == [stab]
+        # executes before the probe -> cannot witness it, not evidence
+        cfk2 = CommandsForKey(Key(1))
+        cfk2.update(probe, InternalStatus.PREACCEPTED)
+        cfk2.update(stab, InternalStatus.STABLE, execute_at=ts(21),
+                    dep_ids=[])
+        assert cfk2.stable_started_before_and_witnessed(probe) == []
+
+    def test_committed_started_before_without_witnessing(self):
+        """A txn committed to execute after the probe whose commit deps omit
+        it enters the await-commit set (earlierAcceptedNoWitness). An
+        ACCEPTED entry never does: its recorded deps are bounded by its own
+        txnId, so the probe is treated as implied-witnessed until commit
+        recomputes the divergence at the executeAt bound (reference
+        depsKnownBefore semantics, CommandsForKey.java:263-280)."""
+        cfk = CommandsForKey(Key(1))
+        probe = wid(15)
+        cfk.update(probe, InternalStatus.PREACCEPTED)
+        early = wid(10)
+        cfk.update(early, InternalStatus.ACCEPTED, execute_at=ts(30),
+                   dep_ids=[])
+        assert cfk.accepted_started_before_without_witnessing(probe) == []
+        # commit without witnessing the probe: missing recomputed at the
+        # executeAt bound, probe now a recorded divergence
+        cfk.update(early, InternalStatus.COMMITTED, execute_at=ts(30),
+                   dep_ids=[])
+        assert cfk.get(early).missing == (probe,)
+        assert cfk.accepted_started_before_without_witnessing(probe) == [early]
+        # committing WITH the probe as dep clears it
+        cfk.update(early, InternalStatus.STABLE, execute_at=ts(30),
+                   dep_ids=[probe])
+        assert cfk.accepted_started_before_without_witnessing(probe) == []
 
 
 class TestPruneRedundant:
     def test_drops_terminal_below_bound(self):
         cfk = CommandsForKey(Key(1))
         a, b, c = wid(10), wid(20), wid(30)
-        cfk.update(a, InternalStatus.APPLIED, execute_at=ts(10))
-        cfk.update(b, InternalStatus.STABLE, execute_at=ts(20))
-        cfk.update(c, InternalStatus.APPLIED, execute_at=ts(30))
+        cfk.update(a, InternalStatus.APPLIED, execute_at=ts(10), dep_ids=[])
+        cfk.update(b, InternalStatus.STABLE, execute_at=ts(20), dep_ids=[a])
+        cfk.update(c, InternalStatus.APPLIED, execute_at=ts(30),
+                   dep_ids=[a, b])
         cfk.prune_redundant(wid(25))
         assert cfk.all_ids() == [b, c]  # b not terminal, c above bound
+
+    def test_committed_view_pruned_too(self):
+        cfk = CommandsForKey(Key(1))
+        a, b = wid(10), wid(20)
+        cfk.update(a, InternalStatus.APPLIED, execute_at=ts(10), dep_ids=[])
+        cfk.update(b, InternalStatus.STABLE, execute_at=ts(20), dep_ids=[a])
+        cfk.prune_redundant(wid(15))
+        assert cfk.max_committed_write_before(ts(100)) == ts(20)

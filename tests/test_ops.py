@@ -23,7 +23,10 @@ STATUSES = list(InternalStatus)
 
 
 def random_world(rng: RandomSource, n_keys=12, n_existing=60, n_batch=16):
-    """Build randomized CFK state + a batch of new txns."""
+    """Build randomized CFK state + a batch of new txns. Committed entries
+    get an executeAt (sometimes bumped past their id, the slow-path shape)
+    and random per-key deps so missing[]/elision paths are exercised."""
+    from accord_tpu.primitives.timestamp import Timestamp
     keys = [Key(i * 10) for i in range(n_keys)]
     cfks = {k: CommandsForKey(k) for k in keys}
     hlc = 100
@@ -32,9 +35,19 @@ def random_world(rng: RandomSource, n_keys=12, n_existing=60, n_batch=16):
         tid = TxnId.create(1, hlc, rng.pick(KINDS), Domain.KEY,
                            rng.next_int(5))
         status = rng.pick(STATUSES)
+        execute_at = None
+        if status.has_info and rng.next_int(3) == 0:
+            # slow path: executeAt bumped past the id
+            execute_at = Timestamp(1, hlc + 5 + rng.next_int(40), 0,
+                                   rng.next_int(5))
         touched = rng.sample(keys, 1 + rng.next_int(3))
         for k in touched:
-            cfks[k].update(tid, status, None)
+            dep_ids = None
+            if status.has_info:
+                pool = cfks[k].all_ids()
+                dep_ids = rng.sample(pool, rng.next_int(len(pool) + 1)) \
+                    if pool else []
+            cfks[k].update(tid, status, execute_at, dep_ids=dep_ids)
     batch = []
     for _ in range(n_batch):
         hlc += 1 + rng.next_int(3)
@@ -46,14 +59,14 @@ def random_world(rng: RandomSource, n_keys=12, n_existing=60, n_batch=16):
 
 
 def scalar_deps(cfks, batch):
-    """Oracle: per-txn deps via the scalar map_reduce_active scan."""
+    """Oracle: per-txn deps via the scalar map_reduce_active scan — with
+    pruning ON, exactly as the protocol path runs it."""
     by_key = {c.key: c for c in cfks}
     out = []
     for tid, keys in batch:
         ids = set()
         for k in keys:
-            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.add,
-                                      prune=False)
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.add)
         out.append(sorted(ids))
     return out
 
@@ -65,8 +78,8 @@ def test_batched_deps_matches_scalar(seed):
     enc = BatchEncoder(cfks, batch)
     s, b = enc.state, enc.dbatch
     dep_mask, dep_count = batched_active_deps(
-        s.entry_rank, s.entry_key, s.entry_status, s.entry_kind,
-        b.txn_rank, b.txn_witness_mask, b.touches)
+        s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
+        s.entry_kind, b.txn_rank, b.txn_witness_mask, b.touches)
     got = enc.decode_deps(np.asarray(dep_mask))
     want = scalar_deps(cfks, batch)
     assert got == want
@@ -78,8 +91,7 @@ def test_batched_deps_matches_scalar(seed):
     for (tid, keys), m in zip(batch, keyed):
         for k in keys:
             ids = []
-            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.append,
-                                          prune=False)
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.append)
             assert m.get(k, []) == sorted(ids)
 
 
@@ -93,8 +105,8 @@ def test_batch_deps_exclude_in_batch_ids(seed):
     batch_ids = {tid for tid, _ in batch}
     s, b = enc.state, enc.dbatch
     dep_mask, _ = batched_active_deps(
-        s.entry_rank, s.entry_key, s.entry_status, s.entry_kind,
-        b.txn_rank, b.txn_witness_mask, b.touches)
+        s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
+        s.entry_kind, b.txn_rank, b.txn_witness_mask, b.touches)
     for row in enc.decode_deps(np.asarray(dep_mask)):
         assert not (set(row) & batch_ids)
 
@@ -149,8 +161,8 @@ def test_sharded_step_matches_unsharded(seed):
     flat = BatchEncoder(cfks, batch)
     s, b = flat.state, flat.dbatch
     _, _, dep_bb1, waves1 = resolve_step(
-        s.entry_rank, s.entry_key, s.entry_status, s.entry_kind,
-        b.txn_rank, b.txn_witness_mask, b.txn_kind, b.touches)
+        s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
+        s.entry_kind, b.txn_rank, b.txn_witness_mask, b.txn_kind, b.touches)
     n = len(batch)
     assert np.array_equal(np.asarray(dep_bb)[:n, :n],
                           np.asarray(dep_bb1)[:n, :n])
