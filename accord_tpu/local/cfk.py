@@ -196,11 +196,12 @@ class CommandsForKey:
             if status == cur and not status.has_info:
                 return
             was_committed = cur.is_committed
+            old_eat = self._eat_of(pos)
             if was_committed and status.is_committed \
                     and execute_at is not None \
-                    and self._eat_of(pos) != execute_at:
+                    and old_eat != execute_at:
                 # executeAt is fixed at commit; keep the committed view exact
-                self._committed_remove(txn_id, self._eat_of(pos))
+                self._committed_remove(txn_id, old_eat)
                 self._committed_add(txn_id, execute_at)
             self._status[pos] = status
             if execute_at is not None:
@@ -208,7 +209,10 @@ class CommandsForKey:
             if status.is_committed and not was_committed:
                 self._committed_add(txn_id, self._eat_of(self._pos(txn_id)))
             if status == InternalStatus.INVALID_OR_TRUNCATED and was_committed:
-                self._committed_remove(txn_id, self._eat_of(pos))
+                # use the eat recorded before the mutation above, so the exact
+                # (eat, txn_id) pair leaves _committed even if the caller
+                # passed a differing execute_at
+                self._committed_remove(txn_id, old_eat)
             if status.is_decided and not (cur.is_decided):
                 # newly Committed-or-higher: elide from all missing[]
                 self._remove_missing(txn_id)

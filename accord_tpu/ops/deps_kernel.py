@@ -27,7 +27,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from accord_tpu.ops.encode import STATUS_INACTIVE, WRITE_KIND
+from accord_tpu.ops.encode import STATUS_INACTIVE, WRITE_KIND_MASK
 
 # InternalStatus numeric bands (accord_tpu.local.cfk.InternalStatus)
 _TRANSITIVELY_KNOWN = 0
@@ -55,7 +55,7 @@ def batched_active_deps(entry_rank: jax.Array, entry_eat_rank: jax.Array,
     # committed writes executing strictly before the querying txn
     committed = (entry_status >= _COMMITTED) & (entry_status <= _APPLIED) \
         & (entry_rank >= 0)
-    is_write = entry_kind == WRITE_KIND
+    is_write = ((WRITE_KIND_MASK >> entry_kind) & 1) == 1
     exec_earlier = entry_eat_rank[None, :] < txn_rank[:, None]   # [B, E]
     cand = jnp.where(committed[None, :] & is_write[None, :] & exec_earlier,
                      entry_eat_rank[None, :], -1)                # [B, E]

@@ -32,7 +32,11 @@ from accord_tpu.primitives.timestamp import TxnId, TxnKind
 
 PAD = 128
 STATUS_INACTIVE = int(InternalStatus.INVALID_OR_TRUNCATED)
-WRITE_KIND = int(TxnKind.WRITE)
+# Bit k set <=> TxnKind(k).is_write — the transitive-elision bound counts
+# committed EXCLUSIVE_SYNC_POINTs as writes, exactly like the host scan
+# (cfk.max_committed_write_before).  Derived from the property so the device
+# predicate has a single source of truth.
+WRITE_KIND_MASK = sum(1 << int(k) for k in TxnKind if k.is_write)
 
 
 def _pad_to(n: int, pad: int) -> int:
@@ -173,3 +177,21 @@ class BatchEncoder:
                 m.setdefault(self.keys[ki], []).append(tid)
             out.append({k: sorted(v) for k, v in m.items()})
         return out
+
+
+def scalar_deps_oracle(cfks: Sequence[CommandsForKey],
+                       batch: Sequence[Tuple[TxnId, Sequence[Key]]]
+                       ) -> List[List[TxnId]]:
+    """The host oracle the device path must match bit-for-bit: per-txn deps
+    via the scalar map_reduce_active scan with pruning on, exactly as the
+    protocol path runs it (CommandsForKey.java:614-650).  Shared by the
+    equivalence tests and dryrun_multichip so there is one copy of the
+    contract."""
+    by_key = {c.key: c for c in cfks}
+    out: List[List[TxnId]] = []
+    for tid, keyset in batch:
+        ids: set = set()
+        for k in keyset:
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.add)
+        out.append(sorted(ids))
+    return out

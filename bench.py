@@ -69,8 +69,7 @@ def scalar_edges_per_sec(cfks, batch):
     t0 = time.perf_counter()
     for tid, keyset in batch:
         for k in keyset:
-            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), count,
-                                      prune=False)
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), count)
     dt = time.perf_counter() - t0
     return edges / dt, edges
 
@@ -85,8 +84,9 @@ def main():
     enc = BatchEncoder(cfks, batch)
     s, b = enc.state, enc.dbatch
     args = [jax.device_put(x) for x in
-            (s.entry_rank, s.entry_key, s.entry_status, s.entry_kind,
-             b.txn_rank, b.txn_witness_mask, b.txn_kind, b.touches)]
+            (s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
+             s.entry_kind, b.txn_rank, b.txn_witness_mask, b.txn_kind,
+             b.touches)]
 
     # compile + warm up
     out = resolve_step(*args)

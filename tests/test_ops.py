@@ -58,17 +58,7 @@ def random_world(rng: RandomSource, n_keys=12, n_existing=60, n_batch=16):
     return list(cfks.values()), batch
 
 
-def scalar_deps(cfks, batch):
-    """Oracle: per-txn deps via the scalar map_reduce_active scan — with
-    pruning ON, exactly as the protocol path runs it."""
-    by_key = {c.key: c for c in cfks}
-    out = []
-    for tid, keys in batch:
-        ids = set()
-        for k in keys:
-            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), ids.add)
-        out.append(sorted(ids))
-    return out
+from accord_tpu.ops.encode import scalar_deps_oracle as scalar_deps
 
 
 @pytest.mark.parametrize("seed", range(8))
