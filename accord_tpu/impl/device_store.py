@@ -1,0 +1,226 @@
+"""DeviceCommandStore: the batched deps kernel on the protocol path.
+
+This is the thesis of the port (SURVEY §7 step 7): a CommandStore that
+implements the SafeCommandStore active-conflict query by *batching* — incoming
+operations accumulate in a flush window; one XLA call computes every declared
+deps scan for the whole window (ops.deps_kernel.batched_active_deps, the
+device formulation of CommandsForKey.mapReduceActive, reference
+accord/local/CommandsForKey.java:614-650); operations then execute serially,
+serving their scans from the precomputed masks.
+
+Equivalence contract: results must be bit-identical to the scalar path.  Two
+mechanisms enforce it:
+
+  * snapshot validation — each CommandsForKey carries a version counter; a
+    precomputed probe is served only if every key it covers is unchanged
+    since the snapshot, with one exception: a single bump whose mutator is
+    the querying txn itself (its own preaccept/accept registration, which
+    the scan excludes anyway).  Anything else — an earlier op in the same
+    window mutating a shared key, a truncation, an unmanaged notification —
+    falls back to the scalar scan.  Correctness never depends on the device
+    result being fresh.
+  * verify mode — every served scan is cross-checked against the scalar scan
+    inline and asserted identical; the burn equivalence tests run with this
+    on, so a whole hostile-cluster run certifies bit-identity at every query.
+
+Range-domain conflicts (RangeDeps tier) always run on the live scalar scan;
+the device tier covers the per-key CommandsForKey scans where the volume is.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from accord_tpu.local.store import (CommandStore, PreLoadContext,
+                                    SafeCommandStore)
+from accord_tpu.primitives.keys import Key, Keys, Ranges
+from accord_tpu.primitives.timestamp import KindSet, Timestamp, TxnId
+
+
+class _Probe:
+    """One precomputed active-scan: deps per key at (before, kinds), plus the
+    snapshot versions that gate serving it."""
+
+    __slots__ = ("before", "kinds", "keyed", "key_set", "versions",
+                 "committed_versions")
+
+    def __init__(self, before: Timestamp, kinds: KindSet,
+                 keyed: Dict[Key, List[TxnId]], key_set: Set[Key],
+                 versions: Dict[Key, int], committed_versions: Dict[Key, int]):
+        self.before = before
+        self.kinds = kinds
+        self.keyed = keyed
+        self.key_set = key_set
+        self.versions = versions
+        self.committed_versions = committed_versions
+
+
+class DeviceSafeCommandStore(SafeCommandStore):
+    def map_reduce_active(self, participants, before: Timestamp,
+                          kinds: KindSet, fn, on_range_dep=None,
+                          exclude: Optional[TxnId] = None) -> None:
+        store: DeviceCommandStore = self.store
+        probe = store._precomputed.get((before, kinds))
+        if probe is None or isinstance(participants, Ranges):
+            store.device_misses += 1
+            return super().map_reduce_active(participants, before, kinds, fn,
+                                             on_range_dep, exclude)
+        owned = self._owned_participants(participants)
+        if not all(k in probe.key_set and self._version_ok(k, probe, exclude)
+                   for k in owned):
+            store.device_misses += 1
+            return super().map_reduce_active(participants, before, kinds, fn,
+                                             on_range_dep, exclude)
+        store.device_hits += 1
+        if store.verify:
+            self._verify_against_scalar(owned, before, kinds, exclude, probe)
+        for key in owned:
+            for dep in probe.keyed.get(key, ()):
+                if dep != exclude:
+                    fn(key, dep)
+        self._map_range_conflicts(owned, False, before, kinds, fn,
+                                  on_range_dep)
+
+    def _version_ok(self, key: Key, probe: _Probe,
+                    exclude: Optional[TxnId]) -> bool:
+        cfk = self.store.cfks.get(key)
+        v = cfk.version if cfk is not None else 0
+        snap = probe.versions.get(key, 0)
+        if v == snap:
+            return True
+        # sole mutation since the snapshot = the querying txn's own
+        # registration, which its scan excludes (deps_kernel `earlier` for
+        # preaccept; commands.calculate_deps' dep != txn_id filter otherwise).
+        # The committed view must be untouched: committing/invalidating the
+        # querier moves the transitive-elision bound, which changes OTHER
+        # entries' visibility — self-exclusion does not cover that.
+        return (v == snap + 1 and exclude is not None
+                and cfk is not None and cfk.last_mutator == exclude
+                and cfk.committed_version
+                == probe.committed_versions.get(key, 0))
+
+    def _verify_against_scalar(self, owned, before, kinds, exclude,
+                               probe: _Probe) -> None:
+        got: Dict[Key, List[TxnId]] = {}
+
+        def collect(k, t):
+            if t != exclude:
+                got.setdefault(k, []).append(t)
+
+        # key tier only — the range tier runs live on both paths
+        for key in owned:
+            cfk = self.store.cfks.get(key)
+            if cfk is not None:
+                cfk.map_reduce_active(before, kinds,
+                                      lambda t, k=key: collect(k, t))
+        for key in owned:
+            want = sorted(got.get(key, []))
+            served = [d for d in probe.keyed.get(key, ()) if d != exclude]
+            if served != want:
+                err = AssertionError(
+                    f"device deps diverge from scalar at {key}: "
+                    f"device={served} scalar={want}")
+                # raise through the agent too: op-level failures become
+                # FailureReplies (a routine nack), which must not mask a
+                # broken equivalence contract in the burn
+                try:
+                    self.store.agent.on_uncaught_exception(err)
+                except Exception:
+                    pass
+                raise err
+
+
+class DeviceCommandStore(CommandStore):
+    """CommandStore with flush-window batching onto the device tier.
+
+    `_submit` defers operations; a zero-delay (or `flush_window_us`-delayed)
+    scheduler event drains the window: one batched kernel call precomputes
+    every declared deps probe, then the operations run serially.
+    """
+
+    def __init__(self, store_id: int, node, ranges, *,
+                 flush_window_us: int = 0, verify: bool = False):
+        super().__init__(store_id, node, ranges)
+        self.flush_window_us = flush_window_us
+        self.verify = verify
+        self._window: List[Tuple[PreLoadContext, object, object]] = []
+        self._flush_scheduled = False
+        self._precomputed: Dict[Tuple[Timestamp, KindSet], _Probe] = {}
+        self.device_hits = 0
+        self.device_misses = 0
+        self.device_batches = 0
+        self.device_batched_probes = 0
+        self.device_max_batch = 0
+
+    @classmethod
+    def factory(cls, flush_window_us: int = 0, verify: bool = False):
+        return lambda i, node, ranges: cls(i, node, ranges,
+                                           flush_window_us=flush_window_us,
+                                           verify=verify)
+
+    def _make_safe(self, context: PreLoadContext) -> SafeCommandStore:
+        return DeviceSafeCommandStore(self, context)
+
+    def _submit(self, context: PreLoadContext, fn, result) -> None:
+        self._window.append((context, fn, result))
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            if self.flush_window_us > 0:
+                self.node.scheduler.once(self.flush_window_us / 1e6,
+                                         self._flush)
+            else:
+                self.node.scheduler.now(self._flush)
+
+    def _flush(self) -> None:
+        self._flush_scheduled = False
+        window, self._window = self._window, []
+        if not window:
+            return
+        self._precompute(window)
+        try:
+            for context, fn, result in window:
+                super()._submit(context, fn, result)
+        finally:
+            self._precomputed = {}
+
+    def _precompute(self, window) -> None:
+        probes: List[Tuple[Timestamp, KindSet, List[Key]]] = []
+        seen: Set[Tuple[Timestamp, KindSet]] = set()
+        for context, _fn, _result in window:
+            for before, kinds, keys in context.deps_probes:
+                if (before, kinds) in seen:
+                    continue
+                owned = keys.slice(self.ranges) if not self.ranges.is_empty \
+                    else keys
+                if len(owned) == 0:
+                    continue
+                seen.add((before, kinds))
+                probes.append((before, kinds, list(owned)))
+        self._precomputed = {}
+        if not probes:
+            return
+
+        from accord_tpu.ops.deps_kernel import batched_active_deps
+        from accord_tpu.ops.encode import BatchEncoder
+
+        touched = sorted({k for _, _, ks in probes for k in ks})
+        cfks = [self.cfks[k] for k in touched if k in self.cfks]
+        versions = {k: (self.cfks[k].version if k in self.cfks else 0)
+                    for k in touched}
+        committed_versions = {
+            k: (self.cfks[k].committed_version if k in self.cfks else 0)
+            for k in touched}
+        enc = BatchEncoder.for_probes(cfks, probes)
+        s, b = enc.state, enc.dbatch
+        dep_mask, _count = batched_active_deps(
+            s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
+            s.entry_kind, b.txn_rank, b.txn_witness_mask, b.touches)
+        keyed = enc.decode_key_deps(np.asarray(dep_mask))
+        self.device_batches += 1
+        self.device_batched_probes += len(probes)
+        self.device_max_batch = max(self.device_max_batch, len(probes))
+        for (before, kinds, ks), m in zip(probes, keyed):
+            self._precomputed[(before, kinds)] = _Probe(
+                before, kinds, m, set(ks), versions, committed_versions)

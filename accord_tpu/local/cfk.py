@@ -144,7 +144,8 @@ class CommandsForKey:
     missing[] divergence encoding and a committed-by-executeAt view."""
 
     __slots__ = ("key", "_ids", "_status", "_eat", "_missing", "_committed",
-                 "_unmanaged", "redundant_before")
+                 "_unmanaged", "redundant_before", "version", "last_mutator",
+                 "committed_version")
 
     def __init__(self, key: Key):
         self.key = key
@@ -156,6 +157,16 @@ class CommandsForKey:
         self._committed: List[Tuple[Timestamp, TxnId]] = []
         self._unmanaged: List[Unmanaged] = []
         self.redundant_before: Optional[TxnId] = None
+        # bumped on every mutation; device-store snapshots validate against it.
+        # last_mutator = the txn of the latest update(), letting a snapshot
+        # tolerate exactly one bump when it is the querying txn's own
+        # registration (invisible to its deps scan, which excludes itself).
+        # committed_version guards the tolerance: a bump that changed the
+        # committed view moved the transitive-elision bound, which affects
+        # OTHER entries' visibility — never tolerable.
+        self.version = 0
+        self.last_mutator: Optional[TxnId] = None
+        self.committed_version = 0
 
     # ------------------------------------------------------------ plumbing --
     def _pos(self, txn_id: TxnId) -> int:
@@ -170,11 +181,13 @@ class CommandsForKey:
         return e if e is not None else self._ids[i]
 
     def _committed_add(self, txn_id: TxnId, at: Timestamp) -> None:
+        self.committed_version += 1
         insort(self._committed, (at, txn_id))
 
     def _committed_remove(self, txn_id: TxnId, at: Timestamp) -> None:
         i = bisect_left(self._committed, (at, txn_id))
         if i < len(self._committed) and self._committed[i] == (at, txn_id):
+            self.committed_version += 1
             del self._committed[i]
 
     # -------------------------------------------------------- maintenance --
@@ -195,6 +208,8 @@ class CommandsForKey:
                 return  # per-key view is monotone
             if status == cur and not status.has_info:
                 return
+            self.version += 1
+            self.last_mutator = txn_id
             was_committed = cur.is_committed
             old_eat = self._eat_of(pos)
             if was_committed and status.is_committed \
@@ -217,6 +232,8 @@ class CommandsForKey:
                 # newly Committed-or-higher: elide from all missing[]
                 self._remove_missing(txn_id)
         else:
+            self.version += 1
+            self.last_mutator = txn_id
             insert_at = -pos - 1
             self._insert(insert_at, txn_id, status, execute_at)
             if status.is_committed:
@@ -297,6 +314,8 @@ class CommandsForKey:
 
     def prune_redundant(self, before: TxnId) -> None:
         """Drop applied/invalidated txns below the redundancy watermark."""
+        self.version += 1
+        self.last_mutator = None
         self.redundant_before = (before if self.redundant_before is None
                                  else max(self.redundant_before, before))
         drop = [i for i, t in enumerate(self._ids)

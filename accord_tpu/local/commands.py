@@ -67,7 +67,7 @@ def calculate_deps(safe_store: SafeCommandStore, txn_id: TxnId, participants,
                 rbuilder.add(r, dep)
 
     safe_store.map_reduce_active(participants, before, kinds, visit,
-                                 on_range_dep=visit_range)
+                                 on_range_dep=visit_range, exclude=txn_id)
     return Deps(builder.build(), rbuilder.build())
 
 

@@ -338,7 +338,9 @@ class Node:
         replies (async-aware), reply to the sender
         (Node.mapReduceConsumeLocal :405 -> CommandStores.mapReduceConsume)."""
         participants = request.participants()
-        context = PreLoadContext.for_txn(request.txn_id)
+        probe = request.deps_probe()
+        context = PreLoadContext.for_txn(
+            request.txn_id, deps_probes=(probe,) if probe is not None else ())
         stores = self.command_stores.intersecting(participants)
         if not stores:
             if reply_context is not None:

@@ -33,21 +33,28 @@ if TYPE_CHECKING:
 class PreLoadContext:
     """Declares the TxnIds/keys an operation touches so async store
     implementations can page them in (PreLoadContext.java:42). The in-memory
-    store ignores it; the simulator uses it to model cache-miss delays."""
+    store ignores it; the simulator uses it to model cache-miss delays.
 
-    __slots__ = ("txn_ids", "keys")
+    `deps_probes` — optional (before, KindSet, keys) tuples declaring the
+    active-conflict scans the operation will run, letting a batched device
+    store precompute them for the whole flush window in one kernel call."""
 
-    def __init__(self, txn_ids: Sequence[TxnId] = (), keys=None):
+    __slots__ = ("txn_ids", "keys", "deps_probes")
+
+    def __init__(self, txn_ids: Sequence[TxnId] = (), keys=None,
+                 deps_probes: Sequence = ()):
         self.txn_ids = tuple(txn_ids)
         self.keys = keys if keys is not None else Keys(())
+        self.deps_probes = tuple(deps_probes)
 
     @classmethod
     def empty(cls) -> "PreLoadContext":
         return cls()
 
     @classmethod
-    def for_txn(cls, txn_id: TxnId, keys=None) -> "PreLoadContext":
-        return cls((txn_id,), keys)
+    def for_txn(cls, txn_id: TxnId, keys=None,
+                deps_probes: Sequence = ()) -> "PreLoadContext":
+        return cls((txn_id,), keys, deps_probes)
 
 
 class SafeCommandStore:
@@ -180,8 +187,8 @@ class SafeCommandStore:
     def map_reduce_active(self, participants, before: Timestamp,
                           kinds: KindSet,
                           fn: Callable[[Key, TxnId], None],
-                          on_range_dep: Callable[[Ranges, TxnId], None] = None
-                          ) -> None:
+                          on_range_dep: Callable[[Ranges, TxnId], None] = None,
+                          exclude: Optional[TxnId] = None) -> None:
         """Active-conflict scan — the deps calculation
         (SafeCommandStore.mapReduceActive -> CommandsForKey.mapReduceActive).
 
@@ -189,6 +196,11 @@ class SafeCommandStore:
         sync point). Key-domain conflicts are reported per key via `fn`;
         range-domain conflicts via `on_range_dep(overlap_ranges, dep_id)`
         (they become RangeDeps entries, reference Deps.Builder domain split).
+
+        `exclude` — the querying txn's own id, which the caller filters from
+        the result anyway (calculate_deps). The scalar scan ignores it; the
+        device store uses it to recognise that the only CFK mutation since
+        its snapshot was the querier's own registration.
         """
         is_range = isinstance(participants, Ranges)
         owned = self._owned_participants(participants)
@@ -199,7 +211,14 @@ class SafeCommandStore:
             if cfk is not None:
                 cfk.map_reduce_active(before, kinds,
                                       lambda t, k=key: fn(k, t))
-        # range-domain txns intersecting the participants are conflicts too
+        self._map_range_conflicts(owned, is_range, before, kinds, fn,
+                                  on_range_dep)
+
+    def _map_range_conflicts(self, owned, is_range: bool, before: Timestamp,
+                             kinds: KindSet, fn, on_range_dep) -> None:
+        """Range-domain txns intersecting the participants are conflicts too.
+        Split out so the device store can serve the per-key tier from its
+        batched kernel while keeping this tier on the live scalar scan."""
         for txn_id, ranges in self.store.range_commands.items():
             if not self._active_range_conflict(txn_id, before, kinds):
                 continue
@@ -396,11 +415,15 @@ class CommandStore:
         self._submit(context, fn, result)
         return result
 
+    def _make_safe(self, context: PreLoadContext) -> SafeCommandStore:
+        """The view handed to operations; subclasses may specialise it."""
+        return SafeCommandStore(self, context)
+
     def _submit(self, context: PreLoadContext, fn, result: Optional[AsyncResult]
                 ) -> None:
         """Base: run inline. Overridden by async/simulated stores."""
         try:
-            value = fn(SafeCommandStore(self, context))
+            value = fn(self._make_safe(context))
         except BaseException as e:  # noqa: BLE001
             if result is not None:
                 result.set_failure(e)

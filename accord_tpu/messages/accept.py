@@ -10,7 +10,7 @@ from __future__ import annotations
 from accord_tpu.local import commands as C
 from accord_tpu.messages.base import MessageType, Reply, TxnRequest
 from accord_tpu.primitives.deps import Deps
-from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.keys import Keys, Route
 from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
 
 
@@ -65,6 +65,12 @@ class Accept(TxnRequest):
                                     before=self.execute_at)
             return AcceptOk(self.txn_id, deps)
         return AcceptNack(outcome)
+
+    def deps_probe(self):
+        if not isinstance(self.participating_keys, Keys):
+            return None
+        return (self.execute_at, self.txn_id.kind.witnesses(),
+                self.participating_keys)
 
     def reduce(self, a: Reply, b: Reply) -> Reply:
         if isinstance(a, AcceptNack):

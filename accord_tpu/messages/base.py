@@ -149,6 +149,12 @@ class TxnRequest(Request):
     def participants(self):
         return self.scope.participants()
 
+    def deps_probe(self):
+        """(before, witness KindSet, data Keys) of the active-conflict scan
+        apply() will run, or None. Lets a batched device store precompute the
+        window's deps in one kernel call (PreLoadContext.deps_probes)."""
+        return None
+
 
 class SimpleReply(Reply):
     type = MessageType.SIMPLE_RSP

@@ -46,6 +46,11 @@ class GetEphemeralReadDeps(TxnRequest):
         super().__init__(txn_id, scope)
         self.keys = keys
 
+    def deps_probe(self):
+        if not isinstance(self.keys, Keys):
+            return None
+        return (Timestamp.max_value(), self.txn_id.kind.witnesses(), self.keys)
+
     def apply(self, safe_store) -> Reply:
         deps = C.calculate_deps(safe_store, self.txn_id, self.keys,
                                 before=Timestamp.max_value())

@@ -34,6 +34,11 @@ class GetDeps(TxnRequest):
         self.keys = keys
         self.before = before
 
+    def deps_probe(self):
+        if not isinstance(self.keys, Keys):
+            return None
+        return (self.before, self.txn_id.kind.witnesses(), self.keys)
+
     def apply(self, safe_store) -> Reply:
         deps = C.calculate_deps(safe_store, self.txn_id, self.keys,
                                 before=self.before)

@@ -12,7 +12,7 @@ from typing import Optional
 from accord_tpu.local import commands as C
 from accord_tpu.messages.base import MessageType, Reply, TxnRequest
 from accord_tpu.primitives.deps import Deps
-from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.keys import Keys, Route
 from accord_tpu.primitives.timestamp import Timestamp, TxnId
 from accord_tpu.primitives.txn import PartialTxn
 
@@ -59,6 +59,12 @@ class PreAccept(TxnRequest):
                 before=self.txn_id)
             return PreAcceptOk(self.txn_id, witnessed_at, deps)
         return PreAcceptNack()
+
+    def deps_probe(self):
+        keys = self.partial_txn.keys
+        if not isinstance(keys, Keys):
+            return None  # range-domain: the RangeDeps tier stays scalar
+        return (self.txn_id, self.txn_id.kind.witnesses(), keys)
 
     def reduce(self, a: Reply, b: Reply) -> Reply:
         if isinstance(a, PreAcceptNack):

@@ -76,11 +76,16 @@ class DeviceBatch:
         self.num_txns = num_txns
 
 
-def witness_mask(kind: TxnKind) -> int:
+def kinds_mask(kinds) -> int:
+    """Pack a KindSet into the device's bitmask encoding."""
     mask = 0
-    for k in kind.witnesses():
+    for k in kinds:
         mask |= 1 << int(k)
     return mask
+
+
+def witness_mask(kind: TxnKind) -> int:
+    return kinds_mask(kind.witnesses())
 
 
 def collect_universe(cfks: Sequence[CommandsForKey],
@@ -106,15 +111,40 @@ class BatchEncoder:
     def __init__(self, cfks: Sequence[CommandsForKey],
                  batch: Sequence[Tuple[TxnId, Sequence[Key]]],
                  pad: int = PAD):
+        self._init(cfks, batch,
+                   [(tid, witness_mask(tid.kind), int(tid.kind), ks)
+                    for tid, ks in batch], pad)
+
+    @classmethod
+    def for_probes(cls, cfks: Sequence[CommandsForKey],
+                   probes: Sequence[Tuple[Timestamp, object, Sequence[Key]]],
+                   pad: int = PAD) -> "BatchEncoder":
+        """Encode deps *probes* — (before, witness KindSet, keys) — instead
+        of new txns.  The active scan is txn-agnostic: its result depends
+        only on the rank bound, the kind mask, and the keys (callers filter
+        their own id afterwards, commands.calculate_deps), so one probe can
+        serve any query with the same (before, kinds)."""
+        self = cls.__new__(cls)
+        self._init(cfks, probes,
+                   [(before, kinds_mask(kinds), 0, ks)
+                    for before, kinds, ks in probes], pad)
+        return self
+
+    def _init(self, cfks, batch, rows, pad: int) -> None:
+        """Shared window setup: `rows` = (timestamp, wmask, kind, keys) per
+        batch item — the only place the two constructors differ."""
         self.pad = pad
         self.keys: List[Key] = sorted({c.key for c in cfks}
-                                      | {k for _, ks in batch for k in ks})
+                                      | {k for ts, _, _, ks in rows
+                                         for k in ks})
         self.key_index: Dict[Key, int] = {k: i for i, k in enumerate(self.keys)}
         self.batch = list(batch)
-
         self.universe, self.rank = collect_universe(
-            cfks, [tid for tid, _ in batch])
+            cfks, [ts for ts, _, _, _ in rows])
+        self._encode_state(cfks)
+        self._encode_batch(rows)
 
+    def _encode_state(self, cfks: Sequence[CommandsForKey]) -> None:
         entries: List[Tuple[int, TxnId, InternalStatus, object]] = []
         for cfk in cfks:
             ki = self.key_index[cfk.key]
@@ -123,10 +153,7 @@ class BatchEncoder:
                 entries.append((ki, tid, status, eat))
         self.entries = entries
 
-        e = _pad_to(max(1, len(entries)), pad)
-        k = _pad_to(max(1, len(self.keys)), pad)
-        b = _pad_to(max(1, len(batch)), pad)
-
+        e = _pad_to(max(1, len(entries)), self.pad)
         entry_rank = np.full(e, -1, np.int32)
         entry_eat_rank = np.full(e, -1, np.int32)
         entry_key = np.zeros(e, np.int32)
@@ -142,18 +169,22 @@ class BatchEncoder:
                                  entry_status, entry_kind,
                                  len(entries), len(self.keys))
 
+    def _encode_batch(self, rows: Sequence[Tuple[Timestamp, int, int,
+                                                 Sequence[Key]]]) -> None:
+        b = _pad_to(max(1, len(rows)), self.pad)
+        k = _pad_to(max(1, len(self.keys)), self.pad)
         txn_rank = np.full(b, -1, np.int32)
         txn_wmask = np.zeros(b, np.int32)
         txn_kind = np.zeros(b, np.int32)
         touches = np.zeros((b, k), bool)
-        for i, (tid, ks) in enumerate(batch):
-            txn_rank[i] = self.rank[tid]
-            txn_wmask[i] = witness_mask(tid.kind)
-            txn_kind[i] = int(tid.kind)
+        for i, (ts, wmask, kind, ks) in enumerate(rows):
+            txn_rank[i] = self.rank[ts]
+            txn_wmask[i] = wmask
+            txn_kind[i] = kind
             for key in ks:
                 touches[i, self.key_index[key]] = True
         self.dbatch = DeviceBatch(txn_rank, txn_wmask, txn_kind, touches,
-                                  len(batch))
+                                  len(rows))
 
     # -- decode --
     def decode_deps(self, dep_mask: np.ndarray) -> List[List[TxnId]]:

@@ -46,7 +46,8 @@ class BurnRun:
                  range_reads: bool = True, durability: bool = True,
                  durability_cycle_s: float = None,
                  topology_changes: bool = True,
-                 topology_period_s: float = 3.0):
+                 topology_period_s: float = 3.0,
+                 store_factory=None):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -59,7 +60,8 @@ class BurnRun:
         self.cluster = SimCluster(
             n_nodes=nodes, seed=self.rng.next_long(), n_shards=n_shards,
             rf=rf, progress_log_factory=progress_log_factory,
-            num_command_stores=num_command_stores)
+            num_command_stores=num_command_stores,
+            store_factory=store_factory)
         if drop_prob > 0:
             self.cluster.network.default_link = LinkConfig(
                 deliver_prob=1.0 - drop_prob)
@@ -220,15 +222,42 @@ def main(argv=None) -> int:
     parser.add_argument("--drop", type=float, default=0.0)
     parser.add_argument("--loops", type=int, default=1,
                         help="run N consecutive seeds")
+    parser.add_argument("--device-store", action="store_true",
+                        help="run deps scans on the batched device tier "
+                             "(flush-window accumulation -> one kernel call)")
+    parser.add_argument("--device-verify", action="store_true",
+                        help="cross-check every device-served scan against "
+                             "the scalar oracle inline")
+    parser.add_argument("--flush-window-us", type=int, default=200,
+                        help="device-store flush window (virtual us)")
     args = parser.parse_args(argv)
+    store_factory = None
+    if args.device_store:
+        from accord_tpu.impl.device_store import DeviceCommandStore
+        store_factory = DeviceCommandStore.factory(
+            flush_window_us=args.flush_window_us, verify=args.device_verify)
     for i in range(args.loops):
         seed = args.seed + i
         run = BurnRun(seed, args.ops, nodes=args.nodes, keys=args.keys,
-                      n_shards=args.shards, drop_prob=args.drop)
+                      n_shards=args.shards, drop_prob=args.drop,
+                      store_factory=store_factory)
         stats = run.run()
+        extra = ""
+        if args.device_store:
+            h = m = b = p = 0
+            mx = 0
+            for node in run.cluster.nodes.values():
+                for s in node.command_stores.all():
+                    h += s.device_hits
+                    m += s.device_misses
+                    b += s.device_batches
+                    p += s.device_batched_probes
+                    mx = max(mx, s.device_max_batch)
+            extra = (f" device[hits={h} misses={m} batches={b} "
+                     f"probes={p} max_batch={mx}]")
         print(f"seed={seed} ops={args.ops} {stats} "
               f"virtual_time={run.cluster.now_s:.1f}s "
-              f"events={run.cluster.queue.processed} OK")
+              f"events={run.cluster.queue.processed} OK{extra}")
         if stats.acks == 0:
             print("PATHOLOGICAL: no transaction succeeded", file=sys.stderr)
             return 1

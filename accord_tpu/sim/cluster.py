@@ -48,7 +48,8 @@ class SimCluster:
 
     def __init__(self, n_nodes: int = 3, seed: int = 0, token_span: int = 1000,
                  n_shards: int = 2, rf: int = None, num_command_stores: int = 1,
-                 progress_log_factory: Optional[Callable] = None):
+                 progress_log_factory: Optional[Callable] = None,
+                 store_factory: Optional[Callable] = None):
         self.random = RandomSource(seed)
         self.queue = PendingQueue(self.random.fork())
         self.network = SimNetwork(self.queue, self.random.fork())
@@ -66,6 +67,7 @@ class SimCluster:
                 nid, sink, agent, self.scheduler, ListStore(nid),
                 self.random.fork(), num_shards=num_command_stores,
                 progress_log_factory=progress_log_factory,
+                store_factory=store_factory,
                 now_us=lambda: self.queue.clock.now_us,
             )
             self.agents[nid] = agent

@@ -1,0 +1,59 @@
+"""Device store on the protocol path: scalar-vs-device burn equivalence.
+
+SURVEY §7 step 7 / the port's thesis: the batched deps kernel serves the
+SafeCommandStore active-conflict queries inside a live consensus cluster and
+must be bit-identical to the scalar path.  `verify=True` cross-checks every
+served scan inline against the scalar oracle and hard-fails the simulation on
+divergence (impl/device_store.DeviceSafeCommandStore._verify_against_scalar),
+so a green burn certifies equivalence at every query of the run.
+"""
+
+import pytest
+
+from accord_tpu.impl.device_store import DeviceCommandStore
+from accord_tpu.sim.burn import BurnRun
+
+
+def _run(seed, ops=60, flush_window_us=200, **kw):
+    factory = DeviceCommandStore.factory(flush_window_us=flush_window_us,
+                                         verify=True)
+    run = BurnRun(seed, ops, store_factory=factory, **kw)
+    stats = run.run()
+    assert stats.acks > 0, "pathological: no transaction succeeded"
+    hits = misses = probes = 0
+    max_batch = 0
+    for node in run.cluster.nodes.values():
+        for s in node.command_stores.all():
+            hits += s.device_hits
+            misses += s.device_misses
+            probes += s.device_batched_probes
+            max_batch = max(max_batch, s.device_max_batch)
+    return stats, hits, misses, probes, max_batch
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_burn_device_store_clean(seed):
+    stats, hits, _misses, probes, _mb = _run(seed)
+    # the device tier must actually carry the load, not just fall back
+    assert hits > 0 and probes > 0
+    assert stats.lost == 0 and stats.pending == 0
+
+
+def test_burn_device_store_lossy():
+    stats, hits, _m, _p, _mb = _run(103, ops=80, drop_prob=0.1)
+    assert hits > 0
+    assert stats.lost == 0 and stats.pending == 0
+
+
+def test_burn_device_store_batches_across_ops():
+    # a wide flush window accumulates multiple probes per kernel call
+    _stats, hits, _m, probes, max_batch = _run(7, ops=80,
+                                               flush_window_us=5000)
+    assert hits > 0
+    assert max_batch >= 2, "flush window never batched more than one probe"
+
+
+def test_device_store_majority_served():
+    # on a clean run the device tier should serve most key-domain scans
+    _stats, hits, misses, _p, _mb = _run(11, ops=60)
+    assert hits > misses, (hits, misses)
