@@ -47,7 +47,10 @@ class BurnRun:
                  durability_cycle_s: float = None,
                  topology_changes: bool = True,
                  topology_period_s: float = 3.0,
-                 store_factory=None):
+                 store_factory=None,
+                 partitions: bool = False,
+                 partition_period_s: float = 8.0,
+                 clock_drift: bool = False):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -61,10 +64,17 @@ class BurnRun:
             n_nodes=nodes, seed=self.rng.next_long(), n_shards=n_shards,
             rf=rf, progress_log_factory=progress_log_factory,
             num_command_stores=num_command_stores,
-            store_factory=store_factory)
+            store_factory=store_factory, clock_drift=clock_drift)
         if drop_prob > 0:
             self.cluster.network.default_link = LinkConfig(
                 deliver_prob=1.0 - drop_prob)
+        self.partition_nemesis = None
+        if partitions:
+            from accord_tpu.sim.network import PartitionNemesis
+            self.partition_nemesis = PartitionNemesis(
+                self.cluster.network, self.cluster.queue, self.rng.fork(),
+                list(self.cluster.nodes), period_s=partition_period_s)
+            self.partition_nemesis.start()
         self.keys = keys
         self.concurrency = concurrency
         self.range_reads = range_reads
@@ -172,10 +182,13 @@ class BurnRun:
         cluster.process_until(
             lambda: submitted[0] >= self.ops and inflight[0] == 0,
             max_items=50_000_000)
-        # quiesce: stop mutating topology, then let replication/recovery
-        # drain (the reference burn similarly settles before verifying)
+        # quiesce: stop mutating topology, heal partitions, then let
+        # replication/recovery drain (the reference burn similarly settles
+        # before verifying)
         if self.nemesis is not None:
             self.nemesis.stop()
+        if self.partition_nemesis is not None:
+            self.partition_nemesis.stop()
         cluster.queue.drain(
             until_us=cluster.queue.clock.now_us + 60_000_000,
             max_items=5_000_000)
@@ -220,6 +233,10 @@ def main(argv=None) -> int:
     parser.add_argument("--keys", type=int, default=20)
     parser.add_argument("--shards", type=int, default=4)
     parser.add_argument("--drop", type=float, default=0.0)
+    parser.add_argument("--partitions", action="store_true",
+                        help="schedule network partitions + heals")
+    parser.add_argument("--drift", action="store_true",
+                        help="per-node drifting wall clocks")
     parser.add_argument("--loops", type=int, default=1,
                         help="run N consecutive seeds")
     parser.add_argument("--device-store", action="store_true",
@@ -240,7 +257,8 @@ def main(argv=None) -> int:
         seed = args.seed + i
         run = BurnRun(seed, args.ops, nodes=args.nodes, keys=args.keys,
                       n_shards=args.shards, drop_prob=args.drop,
-                      store_factory=store_factory)
+                      store_factory=store_factory,
+                      partitions=args.partitions, clock_drift=args.drift)
         stats = run.run()
         extra = ""
         if args.device_store:

@@ -43,13 +43,41 @@ class SimAgent(Agent):
         return Txn(kind, keys_or_ranges)
 
 
+class DriftingClock:
+    """Per-node wall clock: the shared virtual clock plus a bounded random
+    walk (reference BurnTest.java:330-340 — per-node drifting clocks with
+    frequent small jumps and occasional large ones, FrequentLargeRange).
+    The HLC max-folds regressions away (Node.unique_now), so drift exercises
+    timestamp ordering and preaccept-expiry paths without breaking
+    monotonicity."""
+
+    def __init__(self, clock, random: RandomSource, small_us: int = 2_000,
+                 large_us: int = 10_000, bound_us: int = 50_000):
+        self.clock = clock
+        self.random = random
+        self.small_us = small_us
+        self.large_us = large_us
+        self.bound_us = bound_us
+        self.offset = 0
+
+    def now_us(self) -> int:
+        r = self.random
+        step = (r.next_int(-self.large_us, self.large_us)
+                if r.next_float() < 0.1
+                else r.next_int(-self.small_us, self.small_us))
+        self.offset = max(-self.bound_us,
+                          min(self.bound_us, self.offset + step))
+        return max(0, self.clock.now_us + self.offset)
+
+
 class SimCluster:
     """N simulated nodes over a token-range topology."""
 
     def __init__(self, n_nodes: int = 3, seed: int = 0, token_span: int = 1000,
                  n_shards: int = 2, rf: int = None, num_command_stores: int = 1,
                  progress_log_factory: Optional[Callable] = None,
-                 store_factory: Optional[Callable] = None):
+                 store_factory: Optional[Callable] = None,
+                 clock_drift: bool = False):
         self.random = RandomSource(seed)
         self.queue = PendingQueue(self.random.fork())
         self.network = SimNetwork(self.queue, self.random.fork())
@@ -63,12 +91,15 @@ class SimCluster:
         for nid in node_ids:
             agent = SimAgent(self, nid)
             sink = NodeSink(nid, self.network)
+            now_us = (DriftingClock(self.queue.clock, self.random.fork()).now_us
+                      if clock_drift
+                      else (lambda: self.queue.clock.now_us))
             node = Node(
                 nid, sink, agent, self.scheduler, ListStore(nid),
                 self.random.fork(), num_shards=num_command_stores,
                 progress_log_factory=progress_log_factory,
                 store_factory=store_factory,
-                now_us=lambda: self.queue.clock.now_us,
+                now_us=now_us,
             )
             self.agents[nid] = agent
             self.nodes[nid] = node

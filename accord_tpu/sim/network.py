@@ -133,6 +133,55 @@ class SimNetwork:
         self.queue.add(delay, run)
 
 
+class PartitionNemesis:
+    """Periodically severs the cluster into two groups and heals after a
+    random interval (reference Cluster.java:518+ schedules re-partitioning
+    every 5s virtual). Alternates partition/heal ticks; `stop()` heals and
+    cancels, letting the burn quiesce."""
+
+    def __init__(self, network: SimNetwork, queue: PendingQueue,
+                 random: RandomSource, node_ids,
+                 period_s: float = 5.0, max_partition_s: float = 4.0):
+        self.network = network
+        self.queue = queue
+        self.random = random
+        self.node_ids = sorted(node_ids)
+        self.period_us = int(period_s * 1e6)
+        self.max_partition_us = int(max_partition_s * 1e6)
+        self.partitioned = False
+        self.partitions_applied = 0
+        self._stopped = False
+
+    def start(self) -> None:
+        self.queue.add(self.random.next_int(0, self.period_us), self._tick)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self.partitioned:
+            self._heal()
+
+    def _heal(self) -> None:
+        self.network.heal()
+        self.partitioned = False
+
+    def _tick(self) -> None:
+        if self._stopped:
+            return
+        if self.partitioned:
+            self._heal()
+            self.queue.add(self.random.next_int(1, self.period_us), self._tick)
+            return
+        ids = list(self.node_ids)
+        if len(ids) >= 2:
+            self.random.shuffle(ids)
+            cut = 1 + self.random.next_int(len(ids) - 1)
+            self.network.partition(ids[:cut], ids[cut:])
+            self.partitioned = True
+            self.partitions_applied += 1
+        self.queue.add(self.random.next_int(1, self.max_partition_us),
+                       self._tick)
+
+
 class NodeSink(MessageSink):
     """MessageSink bound to one simulated node."""
 

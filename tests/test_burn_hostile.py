@@ -1,0 +1,42 @@
+"""Hostile-network burn: loss + scheduled partitions + clock drift + topology
+churn, simultaneously — the reference burn's full nemesis stack
+(NodeSink.java:45 link actions, Cluster.java:518+ re-partitioning,
+BurnTest.java:330-340 per-node clock drift, TopologyRandomizer).
+
+These run in CI so a regression in recovery-under-hostility cannot merge
+green (topology churn is on by default in BurnRun).
+"""
+
+import pytest
+
+from accord_tpu.sim.burn import BurnRun
+
+
+@pytest.mark.parametrize("seed", [22, 23, 24, 25])
+def test_burn_hostile(seed):
+    run = BurnRun(seed, 80, drop_prob=0.1, partitions=True, clock_drift=True)
+    stats = run.run()
+    assert stats.acks > 0, "pathological: no transaction succeeded"
+    assert stats.lost == 0 and stats.pending == 0
+    # the nemesis must actually have fired
+    assert run.partition_nemesis.partitions_applied > 0
+
+
+def test_burn_hostile_heavy_loss():
+    run = BurnRun(41, 60, drop_prob=0.2, partitions=True, clock_drift=True)
+    stats = run.run()
+    assert stats.acks > 0
+    assert stats.lost == 0 and stats.pending == 0
+
+
+def test_burn_hostile_device_store():
+    from accord_tpu.impl.device_store import DeviceCommandStore
+    run = BurnRun(31, 60, drop_prob=0.1, partitions=True, clock_drift=True,
+                  store_factory=DeviceCommandStore.factory(
+                      flush_window_us=200, verify=True))
+    stats = run.run()
+    assert stats.acks > 0
+    assert stats.lost == 0 and stats.pending == 0
+    hits = sum(s.device_hits for node in run.cluster.nodes.values()
+               for s in node.command_stores.all())
+    assert hits > 0
