@@ -237,6 +237,11 @@ def main(argv=None) -> int:
                         help="schedule network partitions + heals")
     parser.add_argument("--drift", action="store_true",
                         help="per-node drifting wall clocks")
+    parser.add_argument("--stores", type=int, default=1,
+                        help="command stores per node (keyspace shards)")
+    parser.add_argument("--delayed-stores", action="store_true",
+                        help="run store tasks on simulated executors with "
+                             "randomized delays + cache-miss page-in")
     parser.add_argument("--loops", type=int, default=1,
                         help="run N consecutive seeds")
     parser.add_argument("--device-store", action="store_true",
@@ -253,11 +258,17 @@ def main(argv=None) -> int:
         from accord_tpu.impl.device_store import DeviceCommandStore
         store_factory = DeviceCommandStore.factory(
             flush_window_us=args.flush_window_us, verify=args.device_verify)
+    elif args.delayed_stores:
+        from accord_tpu.sim.delayed_store import DelayedCommandStore
+        from accord_tpu.utils.random_source import RandomSource
+        store_factory = DelayedCommandStore.factory(
+            RandomSource(args.seed ^ 0x5D5D))
     for i in range(args.loops):
         seed = args.seed + i
         run = BurnRun(seed, args.ops, nodes=args.nodes, keys=args.keys,
                       n_shards=args.shards, drop_prob=args.drop,
                       store_factory=store_factory,
+                      num_command_stores=args.stores,
                       partitions=args.partitions, clock_drift=args.drift)
         stats = run.run()
         extra = ""
