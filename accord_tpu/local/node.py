@@ -89,6 +89,9 @@ class Node:
         self._progress_logs: Dict[int, ProgressLog] = {}
         self._now_us = now_us or (lambda: 0)
         self._hlc = 0
+        # optional side-effecting-message journal (sim/journal.Journal);
+        # when set, every has_side_effects request is recorded at processing
+        self.journal = None
         self.coordinating: Dict[TxnId, AsyncResult] = {}
         self._reply_seq = 0
         # spans with a staleness-escalation bootstrap in flight (dedup), and
@@ -319,6 +322,9 @@ class Node:
         self._process(request, from_id, reply_context)
 
     def _process(self, request: Request, from_id: int, reply_context) -> None:
+        if self.journal is not None and request.type is not None \
+                and request.type.has_side_effects:
+            self.journal.record(self.id, request)
         try:
             request.process(self, from_id, reply_context)
         except BaseException as e:  # noqa: BLE001
@@ -329,6 +335,9 @@ class Node:
 
     def local_request(self, request: Request) -> None:
         """Apply a local-only request (PROPAGATE_*) to our own stores."""
+        if self.journal is not None and request.type is not None \
+                and request.type.has_side_effects:
+            self.journal.record(self.id, request)
         request.process(self, self.id, None)
 
     # ------------------------------------------------- store fan-out/reduce --

@@ -203,6 +203,13 @@ class BurnRun:
         for obs in observations:
             self.verifier.observe(obs)
         self.verifier.verify(final)
+        # journal-replay durability contract: every live command must be
+        # reconstructible from the node's retained side-effecting messages
+        # (SerializerSupport.reconstruct; test Journal.java:82-303)
+        if self.cluster.journal is not None:
+            from accord_tpu.sim.journal import validate_cluster
+            self.journal_checked, self.journal_skipped = \
+                validate_cluster(self.cluster)
         return self.stats
 
     def _final_histories(self) -> Dict[int, Tuple[int, ...]]:

@@ -77,11 +77,13 @@ class SimCluster:
                  n_shards: int = 2, rf: int = None, num_command_stores: int = 1,
                  progress_log_factory: Optional[Callable] = None,
                  store_factory: Optional[Callable] = None,
-                 clock_drift: bool = False):
+                 clock_drift: bool = False, journal: bool = True):
         self.random = RandomSource(seed)
         self.queue = PendingQueue(self.random.fork())
         self.network = SimNetwork(self.queue, self.random.fork())
         self.scheduler = SimScheduler(self.queue)
+        from accord_tpu.sim.journal import Journal
+        self.journal = Journal() if journal else None
         self.token_span = token_span
         self.nodes: Dict[int, Node] = {}
         self.agents: Dict[int, SimAgent] = {}
@@ -101,6 +103,7 @@ class SimCluster:
                 store_factory=store_factory,
                 now_us=now_us,
             )
+            node.journal = self.journal
             self.agents[nid] = agent
             self.nodes[nid] = node
             self.network.register(node)
