@@ -118,8 +118,11 @@ def find_route(node, txn_id: TxnId, some_participants) -> AsyncResult:
 def maybe_recover(node, txn_id: TxnId, route: Route,
                   prev_status: SaveStatus) -> AsyncResult:
     """Home-shard liveness check: if anyone has moved the txn past
-    `prev_status`, just absorb that knowledge; otherwise drive Recover
-    (coordinate/MaybeRecover.java)."""
+    `prev_status`, just absorb that knowledge; otherwise drive Recover —
+    or, when nobody we can reach knows the full route and the outcome is
+    still undecidable, the multi-shard Invalidate round, which either kills
+    the txn or discovers the route and recovers
+    (coordinate/MaybeRecover.java:95-105)."""
     result: AsyncResult = AsyncResult()
 
     def on_checked(merged: Optional[CheckStatusOk], failure):
@@ -134,7 +137,15 @@ def maybe_recover(node, txn_id: TxnId, route: Route,
                 node.local_request(Propagate(txn_id, full, merged))
             result.try_success(merged)
             return
-        node.recover(txn_id, route).add_callback(
+        best = route
+        if merged is not None and merged.route is not None \
+                and (merged.route.is_full or not route.is_full):
+            best = merged.route
+        undecided = merged is None \
+            or merged.save_status < SaveStatus.PRE_COMMITTED
+        chase = (node.invalidate if undecided and not best.is_full
+                 else node.recover)
+        chase(txn_id, best).add_callback(
             lambda v, f: result.try_failure(f) if f is not None
             else result.try_success(v))
 

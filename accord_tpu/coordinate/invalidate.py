@@ -1,44 +1,187 @@
 """Invalidation coordination.
 
-Reference: accord/coordinate/Invalidate.java (proposeInvalidate: ballot
-promise quorum in the single shard owning one participating key) and
-Commit.Invalidate.commitInvalidate (broadcast). Recovery uses this when it
-proves the transaction cannot have been decided (Recover.java:361-376).
+Reference: accord/coordinate/Invalidate.java — a two-phase machine. Phase 1
+(`Invalidate`) sends BeginInvalidation to every shard the txn may touch and
+folds the votes through InvalidationTracker: a promise quorum in some shard
+plus a decisive fast-path rejection in some shard makes invalidation safe;
+any witnessed Accepted-or-later state instead escalates to recovery with the
+route discovered in the replies. Phase 2 (`ProposeInvalidate`,
+Invalidate.proposeInvalidate / Propose.Invalidate) is the classic ballot
+promise quorum in a single shard, followed by a CommitInvalidate broadcast
+(Commit.Invalidate.commitInvalidate).
+
+Recovery calls phase 2 directly once its own ballot round has proved the
+transaction undecidable (Recover.java:361-376); knowledge-acquisition paths
+that hold only a partial route (MaybeRecover.java:98, FetchData.java:113)
+start at phase 1.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, List, Optional
 
-from accord_tpu.coordinate.errors import Exhausted, Preempted, Timeout
+from accord_tpu.coordinate.errors import (Exhausted, Invalidated, Preempted,
+                                          Timeout)
+from accord_tpu.coordinate.tracking import InvalidationTracker, RequestStatus
+from accord_tpu.local.status import SaveStatus
 from accord_tpu.messages.accept import AcceptInvalidate, AcceptNack
 from accord_tpu.messages.base import Callback, TxnRequest
 from accord_tpu.messages.commit import CommitInvalidate
-from accord_tpu.primitives.keys import Route
+from accord_tpu.messages.invalidate_msg import BeginInvalidation, InvalidateReply
+from accord_tpu.primitives.keys import Ranges, Route
 from accord_tpu.primitives.timestamp import Ballot, TxnId
+from accord_tpu.utils import invariants
+from accord_tpu.utils.async_chains import AsyncResult
+
+
+class Invalidate(Callback):
+    """Multi-shard invalidation round (Invalidate.java:52-280).
+
+    `invalidate_with` is whatever (possibly partial) route knowledge we hold;
+    the round doubles as route discovery — if any replica witnessed the
+    definition we learn the full route and can recover instead."""
+
+    def __init__(self, node, txn_id: TxnId, invalidate_with: Route,
+                 result: AsyncResult, transitively_invoked: bool = False,
+                 ballot: Optional[Ballot] = None):
+        self.node = node
+        self.txn_id = txn_id
+        self.invalidate_with = invalidate_with
+        self.result = result
+        self.transitively_invoked = transitively_invoked
+        if ballot is None:
+            now = node.unique_now()
+            ballot = Ballot(now.epoch, now.hlc, 0, node.id)
+        self.ballot = ballot
+        self.tracker: Optional[InvalidationTracker] = None
+        self.replies: List[InvalidateReply] = []
+        self.prepare_done = False
+        self.done = False
+        self.failure: Optional[BaseException] = None
+
+    def start(self) -> None:
+        topologies = self.node.topology.with_unsynced_epochs(
+            self.invalidate_with.participants(), self.txn_id.epoch,
+            self.txn_id.epoch)
+        self.tracker = InvalidationTracker(topologies)
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies,
+                                             self.invalidate_with)
+            if scope is None:
+                continue
+            self.node.send(to, BeginInvalidation(self.txn_id, scope,
+                                                 self.ballot),
+                           callback=self)
+
+    # ------------------------------------------------------------- callbacks --
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done or self.prepare_done:
+            return
+        self.replies.append(reply)
+        self._handle(self.tracker.record_success(
+            from_id, reply.is_promised, reply.has_decision,
+            reply.accepted_fast_path))
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done or self.prepare_done:
+            return
+        if self.failure is None:
+            self.failure = failure
+        self._handle(self.tracker.record_failure(from_id))
+
+    def _handle(self, status: RequestStatus) -> None:
+        if status == RequestStatus.SUCCESS:
+            self._decide()
+        elif status == RequestStatus.FAILED:
+            self.done = self.prepare_done = True
+            self.result.try_failure(
+                self.failure if self.failure is not None
+                else Preempted(f"invalidation of {self.txn_id} could not "
+                               f"obtain promises"))
+
+    # -------------------------------------------------------------- decision --
+    def _decide(self) -> None:
+        """Votes are in (Invalidate.java:146-242): if anything decided or
+        Accepted-or-later was witnessed, recovery must finish the txn; a bare
+        PreAccept may still race with its own fast path unless some shard
+        decisively rejected it; otherwise invalidate outright."""
+        invariants.check_state(not self.prepare_done,
+                               "invalidation decided twice")
+        self.prepare_done = True
+
+        full_route = InvalidateReply.find_full_route(self.replies)
+        max_reply = InvalidateReply.max(self.replies)
+        status = max_reply.status
+
+        if status.is_truncated:
+            # durably applied (and shed) or erased: nothing left to decide
+            self.done = True
+            self.result.try_success(None)
+            return
+        if status == SaveStatus.INVALIDATED:
+            self._commit_invalidate()
+            return
+
+        racy_preaccept = (status == SaveStatus.PRE_ACCEPTED
+                          and not (self.tracker.is_safe_to_invalidate
+                                   or self.transitively_invoked))
+        if status >= SaveStatus.ACCEPTED or racy_preaccept:
+            # someone may have (or provably could have) decided: recover.
+            # every replica that preaccepts/accepts records the full route
+            # (TxnRequest.full_route piggyback), so a witness implies a route
+            invariants.check_state(
+                full_route is not None,
+                "%s witnessed at %s but no replica returned a full route",
+                self.txn_id, status.name)
+            from accord_tpu.coordinate.recover import Recover
+            Recover(self.node, self.txn_id, full_route, self.result,
+                    ballot=self.ballot).start()
+            return
+
+        # NOT_DEFINED / ACCEPTED_INVALIDATE / provably-unfast PRE_ACCEPTED:
+        # finish the invalidation in the shard that promised us
+        shard = self.tracker.promised_shard()
+        ProposeInvalidate(self.node, self.ballot, self.txn_id,
+                          self.invalidate_with, self._commit_invalidate,
+                          self._fail, shard=shard).start()
+
+    def _commit_invalidate(self) -> None:
+        self.done = True
+        merged = InvalidateReply.merge_routes(self.replies)
+        commit_to = (merged.with_(self.invalidate_with) if merged is not None
+                     else self.invalidate_with)
+        commit_invalidate(self.node, self.txn_id, commit_to)
+        self.node.events.on_invalidated(self.txn_id)
+        self.result.try_failure(
+            Invalidated(f"{self.txn_id} invalidated"))
+
+    def _fail(self, failure: BaseException) -> None:
+        self.done = True
+        self.result.try_failure(failure)
 
 
 class ProposeInvalidate(Callback):
-    """Promise `ballot` to invalidate at a quorum of the shard owning the
-    route's home key (Invalidate.proposeInvalidate)."""
+    """Promise `ballot` to invalidate at a quorum of a single shard owning
+    part of the route (Invalidate.proposeInvalidate). Defaults to the home
+    shard; the multi-shard round passes whichever shard promised it."""
 
     def __init__(self, node, ballot: Ballot, txn_id: TxnId, route: Route,
-                 on_done, on_failed):
+                 on_done, on_failed, shard=None):
         self.node = node
         self.ballot = ballot
         self.txn_id = txn_id
         self.route = route
         self._on_done = on_done
         self._on_failed = on_failed
-        self.shard = None
+        self.shard = shard
         self.promises = set()
         self.failures = set()
         self.done = False
 
     def start(self) -> None:
-        from accord_tpu.primitives.keys import Ranges
-        topology = self.node.topology.for_epoch(self.txn_id.epoch)
-        self.shard = topology.shard_for_key(self.route.home_key)
+        if self.shard is None:
+            topology = self.node.topology.for_epoch(self.txn_id.epoch)
+            self.shard = topology.shard_for_key(self.route.home_key)
         scope = self.route.slice(Ranges([self.shard.range]))
         for to in self.shard.nodes:
             self.node.send(to, AcceptInvalidate(self.txn_id, self.ballot,

@@ -294,39 +294,126 @@ class AppliedTracker(QuorumTracker):
     """Waits for apply acks (durability rounds; AppliedTracker.java)."""
 
 
-class InvalidationShardTracker(FastPathShardTracker):
-    __slots__ = ()
+class InvalidationShardTracker(ShardTracker):
+    """Per-shard invalidation vote state (InvalidationTracker.java:30-133).
+
+    A promise counts toward this shard's slow-path quorum. An electorate
+    member that replies *without* having witnessed the txn at its original
+    timestamp is a fast-path reject — its promise also bars it from casting a
+    late fast-path accept, so the rejection is decisive. A failed replica
+    consumes electorate budget without rejecting (it may have voted accept
+    before dying)."""
+
+    __slots__ = ("promises", "rejects", "fast_path_rejects",
+                 "fast_path_responded", "has_decision")
+
+    def __init__(self, shard: Shard):
+        super().__init__(shard)
+        self.promises: Set[int] = set()
+        self.rejects: Set[int] = set()            # replied without promising
+        self.fast_path_rejects: Set[int] = set()
+        self.fast_path_responded: Set[int] = set()  # electorate heard from
+        self.has_decision = False
+
+    def on_reply(self, node: int, promised: bool, has_decision: bool,
+                 accepted_fast_path: bool) -> None:
+        if node in self.shard.fast_path_electorate:
+            self.fast_path_responded.add(node)
+            if not accepted_fast_path:
+                self.fast_path_rejects.add(node)
+        if promised:
+            self.promises.add(node)
+        else:
+            self.rejects.add(node)
+        if has_decision:
+            self.has_decision = True
+
+    def on_node_failure(self, node: int) -> None:
+        # can no longer vote either way; not a rejection
+        if node in self.shard.fast_path_electorate:
+            self.fast_path_responded.add(node)
+        self.failures.add(node)
+
+    @property
+    def is_promised(self) -> bool:
+        return len(self.promises) >= self.shard.slow_path_quorum_size
+
+    @property
+    def is_promise_rejected(self) -> bool:
+        """A promise quorum is no longer achievable in this shard."""
+        outstanding = (self.shard.rf - len(self.promises) - len(self.rejects)
+                       - len(self.failures))
+        return (len(self.promises) + outstanding
+                < self.shard.slow_path_quorum_size)
+
+    @property
+    def is_fast_path_rejected(self) -> bool:
+        return self.shard.rejects_fast_path(len(self.fast_path_rejects))
+
+    @property
+    def can_fast_path_be_rejected(self) -> bool:
+        inflight = (len(self.shard.fast_path_electorate)
+                    - len(self.fast_path_responded))
+        return self.shard.rejects_fast_path(
+            len(self.fast_path_rejects) + inflight)
+
+    @property
+    def is_fast_path_decided(self) -> bool:
+        return self.is_fast_path_rejected or not self.can_fast_path_be_rejected
+
+    @property
+    def is_final(self) -> bool:
+        """No further reply can change this shard's contribution."""
+        return self.has_decision or (
+            self.is_fast_path_decided
+            and (self.is_promised or self.is_promise_rejected))
+
+    @property
+    def is_promised_or_has_decision(self) -> bool:
+        return self.is_promised or self.has_decision
 
 
 class InvalidationTracker(AbstractTracker):
-    """Promise quorum for invalidation, plus per-shard fast-path rejection
-    observation (InvalidationTracker.java). Success = promise quorum in any
-    single shard + knowledge the fast path is impossible there; we surface the
-    pieces and let Invalidate compose them."""
+    """Vote accounting for the multi-shard BeginInvalidation round
+    (InvalidationTracker.java).
+
+    SUCCESS when EITHER some shard reached a promise quorum AND some shard
+    proved the fast path impossible (safe to invalidate outright), OR every
+    shard is final and each holds a promise quorum or a witnessed decision
+    (recovery — or our invalidation — is guaranteed to resolve). FAILED when
+    every shard is final and some shard neither promised nor saw a decision."""
 
     tracker_factory = InvalidationShardTracker
 
-    def record_success(self, node: int, promised: bool,
-                       fast_path_permitted: bool) -> RequestStatus:
-        def fn(t: InvalidationShardTracker, n: int):
-            if promised:
-                t.on_success(n)
-            else:
-                t.on_failure(n)
-            if not fast_path_permitted:
-                t.on_fast_path_reject(n)
+    def record_success(self, node: int, promised: bool, has_decision: bool,
+                       accepted_fast_path: bool) -> RequestStatus:
         for t in self.trackers_for(node):
-            fn(t, node)
-        if any(t.has_reached_quorum for t in self.trackers):
+            t.on_reply(node, promised, has_decision, accepted_fast_path)
+        return self._status()
+
+    def record_failure(self, node: int) -> RequestStatus:
+        for t in self.trackers_for(node):
+            t.on_node_failure(node)
+        return self._status()
+
+    def _status(self) -> RequestStatus:
+        if self.is_promised and self.is_safe_to_invalidate:
             return RequestStatus.SUCCESS
-        if all(t.has_failed for t in self.trackers):
+        if all(t.is_final for t in self.trackers):
+            if all(t.is_promised_or_has_decision for t in self.trackers):
+                return RequestStatus.SUCCESS
             return RequestStatus.FAILED
         return RequestStatus.NO_CHANGE
 
     @property
     def is_promised(self) -> bool:
-        return any(t.has_reached_quorum for t in self.trackers)
+        return any(t.is_promised for t in self.trackers)
+
+    def promised_shard(self) -> Shard:
+        return next(t.shard for t in self.trackers if t.is_promised)
 
     @property
-    def is_fast_path_rejected(self) -> bool:
-        return any(t.has_rejected_fast_path for t in self.trackers)
+    def is_safe_to_invalidate(self) -> bool:
+        """Some shard decisively rejected the fast path: the txn cannot have
+        been fast-path committed anywhere."""
+        return any(t.is_fast_path_rejected for t in self.trackers)

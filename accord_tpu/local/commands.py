@@ -219,6 +219,23 @@ def accept(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot,
     return AcceptOutcome.SUCCESS
 
 
+def preaccept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId,
+                         ballot: Ballot) -> bool:
+    """Promise `ballot` toward invalidation without proposing anything:
+    raises the command's promised ballot so neither the original coordinator
+    nor a stale recovery can make progress beneath us
+    (Commands.preacceptInvalidate :198-217). Returns False — promise
+    refused — once a decision is durable (Committed+/truncated) or a higher
+    ballot holds the promise."""
+    cmd = safe_store.get(txn_id)
+    if cmd.has_been(SaveStatus.COMMITTED) or cmd.is_truncated:
+        return False
+    if not cmd.may_accept(ballot):
+        return False
+    cmd.set_promised(ballot)
+    return True
+
+
 def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId,
                       ballot: Ballot) -> AcceptOutcome:
     """Promise to invalidate (Commands.acceptInvalidate :267)."""

@@ -265,6 +265,22 @@ class Node:
                         lambda: Recover(self, txn_id, route, result).start())
         return result
 
+    def invalidate(self, txn_id: TxnId, some_route: Route) -> AsyncResult:
+        """Multi-shard invalidation entry, for txns we hold only partial
+        route knowledge of (Invalidate.invalidate); doubles as route
+        discovery and escalates to Recover if anything was witnessed."""
+        from accord_tpu.coordinate.invalidate import Invalidate
+        existing = self.coordinating.get(txn_id)
+        if existing is not None:
+            return existing
+        result = AsyncResult()
+        self.coordinating[txn_id] = result
+        result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
+        self.with_epoch(txn_id.epoch,
+                        lambda: Invalidate(self, txn_id, some_route,
+                                           result).start())
+        return result
+
     def with_epoch(self, epoch: int, fn: Callable[[], None]) -> None:
         """Run fn once `epoch` is locally known (Node.withEpoch)."""
         if self.topology.has_epoch(epoch):

@@ -78,6 +78,7 @@ def reconstruct(records: List[object]) -> Dict[TxnId, Reconstruction]:
     from accord_tpu.messages.accept import Accept, AcceptInvalidate
     from accord_tpu.messages.apply_msg import Apply
     from accord_tpu.messages.commit import Commit, CommitInvalidate
+    from accord_tpu.messages.invalidate_msg import BeginInvalidation
     from accord_tpu.messages.preaccept import PreAccept
     from accord_tpu.messages.propagate import Propagate
     from accord_tpu.messages.recover import BeginRecovery
@@ -101,7 +102,7 @@ def reconstruct(records: List[object]) -> Dict[TxnId, Reconstruction]:
                 r.definition_keys |= _keys_of(msg.partial_txn.keys)
         elif isinstance(msg, Accept):
             r.accept_evidence = True
-        elif isinstance(msg, AcceptInvalidate):
+        elif isinstance(msg, (AcceptInvalidate, BeginInvalidation)):
             r.accept_evidence = True
         elif isinstance(msg, Commit):
             r.execute_ats.add(msg.execute_at)

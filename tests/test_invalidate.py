@@ -1,0 +1,199 @@
+"""Multi-shard invalidation: the BeginInvalidation voting round.
+
+Reference model: accord/coordinate/Invalidate.java + InvalidationTracker.java
+— invalidation races against a slow/dead coordinator holding only partial
+route knowledge, and must either prove the fast path impossible (then kill
+the txn) or discover the route and hand off to recovery.
+"""
+
+import pytest
+
+from accord_tpu.coordinate.errors import Invalidated
+from accord_tpu.coordinate.tracking import InvalidationTracker, RequestStatus
+from accord_tpu.impl.list_store import ListQuery, ListRead, ListUpdate
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.messages.accept import Accept
+from accord_tpu.messages.preaccept import PreAccept
+from accord_tpu.primitives.keys import Key, Keys, Range, Route, RoutingKeys
+from accord_tpu.primitives.timestamp import Domain, TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topologies import Topologies
+from accord_tpu.topology.topology import Topology
+
+from tests.test_recover import abandoned_txn, run_txn, rw_txn
+
+
+def partial_route(route: Route) -> Route:
+    """The degraded knowledge an InformOfTxn-style witness would hold: some
+    participating keys, but not the full cover."""
+    keys = RoutingKeys(route.keys[:1])
+    return Route(route.home_key, keys=keys, is_full=False)
+
+
+def status_on(cluster, node_id, txn_id):
+    statuses = [cmd.save_status
+                for store in cluster.node(node_id).command_stores.all()
+                for tid, cmd in store.commands.items() if tid == txn_id]
+    return max(statuses) if statuses else None
+
+
+def invalidate(cluster, node_id, txn_id, route):
+    res = cluster.node(node_id).invalidate(txn_id, route)
+    assert cluster.process_until(lambda: res.is_done)
+    return res
+
+
+class TestInvalidateDecisions:
+    def test_invalidates_unwitnessed_txn(self):
+        """Coordinator died before any PreAccept arrived: nobody witnessed
+        the txn, every shard promises and rejects the fast path, and the
+        multi-shard round invalidates outright."""
+        cluster = SimCluster(n_nodes=3, seed=21)
+        txn_id, route, client = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, PreAccept))
+        assert client.failure() is not None
+
+        res = invalidate(cluster, 2, txn_id, partial_route(route))
+        assert isinstance(res.failure(), Invalidated)
+        cluster.process_until(
+            lambda: all(status_on(cluster, n, txn_id) == SaveStatus.INVALIDATED
+                        for n in cluster.nodes
+                        if status_on(cluster, n, txn_id) is not None))
+        # the key is free for later txns
+        assert run_txn(cluster, 3, rw_txn([10], {10: 8})) is not None
+        for n in cluster.nodes.values():
+            assert 7 not in (n.data_store.get(Key(10)) or ())
+
+    def test_invalidates_minority_preaccept(self):
+        """PreAccept reached one replica only: that replica's vote cannot
+        have completed a fast-path quorum and the other replies prove
+        rejection, so invalidation wins the race — including on the replica
+        that witnessed the preaccept."""
+        cluster = SimCluster(n_nodes=3, seed=22)
+        txn_id, route, client = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, (PreAccept, Accept)) and t != 1)
+        assert client.failure() is not None
+        assert status_on(cluster, 1, txn_id) is not None  # witnessed at 1
+
+        res = invalidate(cluster, 3, txn_id, partial_route(route))
+        assert isinstance(res.failure(), Invalidated)
+        cluster.process_until(
+            lambda: status_on(cluster, 1, txn_id) == SaveStatus.INVALIDATED)
+        assert status_on(cluster, 1, txn_id) == SaveStatus.INVALIDATED
+        for n in cluster.nodes.values():
+            assert 7 not in (n.data_store.get(Key(10)) or ())
+
+    def test_recovers_fully_preaccepted_txn(self):
+        """PreAccept reached everyone (the fast path may have committed):
+        invalidation must NOT kill the txn — it discovers the full route from
+        the witnesses and escalates to recovery, which completes it."""
+        cluster = SimCluster(n_nodes=3, seed=23)
+        from accord_tpu.messages.commit import Commit
+        txn_id, route, client = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, Commit))
+        assert client.failure() is not None
+
+        res = invalidate(cluster, 2, txn_id, partial_route(route))
+        assert res.failure() is None, f"unexpected failure {res.failure()}"
+        cluster.process_until(
+            lambda: all(n.data_store.get(Key(10)) == (7,)
+                        for n in cluster.nodes.values()))
+        for n in cluster.nodes.values():
+            assert n.data_store.get(Key(10)) == (7,)
+
+    def test_recovers_decided_txn(self):
+        """The txn already applied: the round sees the decision and defers to
+        recovery's outcome-propagation path; the write survives."""
+        cluster = SimCluster(n_nodes=3, seed=24)
+        assert run_txn(cluster, 1, rw_txn([], {10: 7})) is not None
+        node = cluster.node(1)
+        cluster.process_until(lambda: any(
+            cmd.save_status >= SaveStatus.PRE_APPLIED
+            for store in node.command_stores.all()
+            for tid, cmd in store.commands.items()
+            if tid.kind == TxnKind.WRITE))
+        txn_id = next(tid for store in node.command_stores.all()
+                      for tid, cmd in store.commands.items()
+                      if cmd.save_status >= SaveStatus.PRE_APPLIED
+                      and tid.kind == TxnKind.WRITE)
+        route = next(cmd.route for store in node.command_stores.all()
+                     for tid, cmd in store.commands.items() if tid == txn_id)
+
+        res = invalidate(cluster, 2, txn_id, partial_route(route))
+        assert res.failure() is None
+        for n in cluster.nodes.values():
+            assert n.data_store.get(Key(10)) == (7,)
+
+    def test_maybe_recover_partial_route_invalidates(self):
+        """The progress-log escalation path: maybe_recover holding only a
+        partial route for an unwitnessed txn routes through Invalidate."""
+        from accord_tpu.coordinate.fetch import maybe_recover
+        cluster = SimCluster(n_nodes=3, seed=25)
+        txn_id, route, client = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, PreAccept))
+        res = maybe_recover(cluster.node(2), txn_id, partial_route(route),
+                            SaveStatus.NOT_DEFINED)
+        assert cluster.process_until(lambda: res.is_done)
+        assert isinstance(res.failure(), Invalidated)
+
+
+class TestInvalidationTracker:
+    def _topologies(self, n=3):
+        shard = Shard(Range(0, 1000), list(range(1, n + 1)))
+        return Topologies([Topology(1, [shard])])
+
+    def test_promise_plus_fast_path_reject_is_success(self):
+        t = InvalidationTracker(self._topologies())
+        assert t.record_success(1, True, False, False) == RequestStatus.NO_CHANGE
+        assert t.record_success(2, True, False, False) == RequestStatus.SUCCESS
+        assert t.is_promised and t.is_safe_to_invalidate
+        assert t.promised_shard() is not None
+
+    def test_all_fast_path_accepts_escalate_not_fail(self):
+        """Every replica witnessed at original: no shard can reject the fast
+        path, but with promises everywhere the round still succeeds (the
+        coordinator then recovers rather than invalidating)."""
+        t = InvalidationTracker(self._topologies())
+        t.record_success(1, True, False, True)
+        t.record_success(2, True, False, True)
+        st = t.record_success(3, True, False, True)
+        assert st == RequestStatus.SUCCESS
+        assert not t.is_safe_to_invalidate
+
+    def test_superseded_promises_fail(self):
+        """All replicas hold a higher promise: once every shard is final with
+        neither a promise quorum nor a decision, the round fails (a competing
+        coordinator owns the txn)."""
+        t = InvalidationTracker(self._topologies())
+        assert t.record_success(1, False, False, True) == RequestStatus.NO_CHANGE
+        # two rejects end promise hopes, but the fast path is still openable
+        # by the third electorate member, so the shard is not yet final
+        assert t.record_success(2, False, False, True) == RequestStatus.NO_CHANGE
+        assert t.record_success(3, False, False, True) == RequestStatus.FAILED
+
+    def test_decision_counts_as_resolution(self):
+        """A witnessed decision substitutes for a promise: the round succeeds
+        so the coordinator can defer to recovery."""
+        t = InvalidationTracker(self._topologies())
+        t.record_success(1, False, True, True)
+        t.record_success(2, False, True, True)
+        st = t.record_success(3, False, True, True)
+        assert st == RequestStatus.SUCCESS
+
+    def test_failures_do_not_reject_fast_path(self):
+        """Dead replicas may have voted accept before dying: they consume
+        electorate budget without proving rejection."""
+        t = InvalidationTracker(self._topologies())
+        t.record_failure(1)
+        t.record_success(2, True, False, True)
+        st = t.record_success(3, True, False, True)
+        # promised (2 of 3) but fast path undecidable -> still final:
+        # remaining rejects (0) + inflight (0) cannot reject
+        assert st == RequestStatus.SUCCESS
+        assert not t.is_safe_to_invalidate
