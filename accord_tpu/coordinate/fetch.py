@@ -138,9 +138,11 @@ def maybe_recover(node, txn_id: TxnId, route: Route,
             result.try_success(merged)
             return
         best = route
-        if merged is not None and merged.route is not None \
-                and (merged.route.is_full or not route.is_full):
-            best = merged.route
+        if merged is not None and merged.route is not None:
+            # union the route fragments (Route.with_ keeps is_full if either
+            # side covers the txn) — replacing would drop participants the
+            # reply happens not to know
+            best = route.with_(merged.route)
         undecided = merged is None \
             or merged.save_status < SaveStatus.PRE_COMMITTED
         chase = (node.invalidate if undecided and not best.is_full

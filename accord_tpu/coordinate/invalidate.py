@@ -18,7 +18,7 @@ start at phase 1.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from accord_tpu.coordinate.errors import (Exhausted, Invalidated, Preempted,
                                           Timeout)
@@ -94,6 +94,14 @@ class Invalidate(Callback):
             self._decide()
         elif status == RequestStatus.FAILED:
             self.done = self.prepare_done = True
+            superseding = [r.superseded_by for r in self.replies
+                           if r.superseded_by is not None]
+            if superseding:
+                # bump our HLC past the superseding promise so a retry mints
+                # a higher ballot even against a fast remote clock (mirrors
+                # Recover's RecoverNack handling)
+                self.node.on_remote_timestamp(max(superseding))
+                self.node.events.on_preempted(self.txn_id)
             self.result.try_failure(
                 self.failure if self.failure is not None
                 else Preempted(f"invalidation of {self.txn_id} could not "
@@ -127,12 +135,17 @@ class Invalidate(Callback):
                                    or self.transitively_invoked))
         if status >= SaveStatus.ACCEPTED or racy_preaccept:
             # someone may have (or provably could have) decided: recover.
-            # every replica that preaccepts/accepts records the full route
-            # (TxnRequest.full_route piggyback), so a witness implies a route
-            invariants.check_state(
-                full_route is not None,
-                "%s witnessed at %s but no replica returned a full route",
-                self.txn_id, status.name)
+            # preaccept/accept/commit all piggyback the full route, but a
+            # replica may know a decision only through a partial-route
+            # Propagate (precommit) — then nobody we reached has the full
+            # route and we must retreat and let the progress log retry once
+            # knowledge spreads
+            if full_route is None:
+                self.done = True
+                self.result.try_failure(Exhausted(
+                    f"{self.txn_id} witnessed at {status.name} but no "
+                    f"reachable replica knows the full route"))
+                return
             from accord_tpu.coordinate.recover import Recover
             Recover(self.node, self.txn_id, full_route, self.result,
                     ballot=self.ballot).start()

@@ -116,8 +116,11 @@ class Recover(Callback):
                                                    deps))
             return
         if status == SaveStatus.ACCEPTED:
-            # re-propose the highest-ballot accepted (executeAt, deps)
-            self._propose(merged, merged.execute_at, merged.deps)
+            # re-propose the highest-ballot accepted executeAt with the
+            # range-wise proposal merge (max-ballot proposals where they
+            # exist, unioned local calculations elsewhere)
+            self._propose(merged, merged.execute_at,
+                          merged.latest_deps.merge_proposal())
             return
         if status == SaveStatus.ACCEPTED_INVALIDATE:
             self._invalidate(merged)
@@ -132,7 +135,8 @@ class Recover(Callback):
         if not merged.earlier_no_witness.is_empty:
             self._await_commits(merged.earlier_no_witness)
             return
-        self._propose(merged, self.txn_id.as_timestamp(), merged.deps)
+        self._propose(merged, self.txn_id.as_timestamp(),
+                      merged.latest_deps.merge_proposal())
 
     # --------------------------------------------------------- continuations --
     def _reconstitute(self, merged: RecoverOk) -> Txn:
@@ -205,25 +209,42 @@ class Recover(Callback):
         self._with_committed_deps(merged, with_deps)
 
     def _with_committed_deps(self, merged: RecoverOk, with_deps) -> None:
-        """Union the committed deps found with a fresh CollectDeps round
-        bounded by executeAt (Recover.withCommittedDeps + CollectDeps).
-
-        Key-coverage of the recovered committed deps cannot be derived from
-        the deps alone (a key with no conflicts is legitimately absent), so we
-        conservatively collect fresh deps for all keys and union: for a
-        committed txn, any superset of its conflicts < executeAt is a sound
-        execution-ordering input."""
-        known = merged.committed_deps
-        collect = CollectDeps(self.node, self.txn_id, self.route,
+        """Range-wise merge of the quorum's committed deps
+        (Recover.withCommittedDeps over LatestDeps.mergeCommit): ranges with
+        committed knowledge — or, for a fast-path decision
+        (executeAt == txnId), with locally-calculated equivalents — are
+        sufficient as-is; only the remainder needs a fresh CollectDeps round
+        bounded by executeAt."""
+        use_local = merged.execute_at == self.txn_id.as_timestamp()
+        deps, sufficient = merged.latest_deps.merge_commit(use_local)
+        missing = self._route_not_covered_by(sufficient)
+        if missing is None:
+            with_deps(deps)
+            return
+        collect = CollectDeps(self.node, self.txn_id, missing,
                               merged.execute_at)
 
         def collected(fresh: Deps, failure: BaseException = None):
             if failure is not None:
                 self._fail(failure)
                 return
-            with_deps(known.with_(fresh) if known is not None else fresh)
+            with_deps(deps.with_(fresh))
 
         collect.start(collected)
+
+    def _route_not_covered_by(self, sufficient) -> Optional[Route]:
+        """The slice of our route with no sufficient deps, or None."""
+        if self.route.is_key_domain:
+            from accord_tpu.primitives.keys import RoutingKeys
+            keys = RoutingKeys([k for k in self.route.keys
+                                if not sufficient.contains(k)])
+            if len(keys) == 0:
+                return None
+            return Route(self.route.home_key, keys=keys, is_full=False)
+        remainder = self.route.ranges.subtract(sufficient)
+        if remainder.is_empty:
+            return None
+        return Route(self.route.home_key, ranges=remainder, is_full=False)
 
     def _await_commits(self, waiting_on: Deps) -> None:
         """WaitOnCommit each blocking dep at a quorum of the shards it

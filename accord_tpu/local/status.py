@@ -128,9 +128,12 @@ class SaveStatus(enum.IntEnum):
         if self >= SaveStatus.ACCEPTED:
             return Known(route, definition, KnownExecuteAt.PROPOSED,
                          KnownDeps.PROPOSED, KnownOutcome.UNKNOWN)
+        # PRE_ACCEPTED / ACCEPTED_INVALIDATE: no coordinator proposal held —
+        # deps are unknown here (reference Status.java:51: only Accepted
+        # carries DepsProposed)
         if self >= SaveStatus.PRE_ACCEPTED:
             return Known(route, definition, KnownExecuteAt.PROPOSED,
-                         KnownDeps.PROPOSED, KnownOutcome.UNKNOWN)
+                         KnownDeps.UNKNOWN, KnownOutcome.UNKNOWN)
         return Known.NOTHING
 
 

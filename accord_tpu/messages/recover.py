@@ -12,10 +12,11 @@ from __future__ import annotations
 from typing import Optional
 
 from accord_tpu.local import commands as C
-from accord_tpu.local.status import SaveStatus
+from accord_tpu.local.status import KnownDeps, SaveStatus
 from accord_tpu.messages.base import MessageType, Reply, TxnRequest
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keys import Key, Keys, Route
+from accord_tpu.primitives.latest_deps import LatestDeps
 from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
 from accord_tpu.primitives.txn import PartialTxn
 from accord_tpu.primitives.writes import Writes
@@ -26,8 +27,7 @@ class RecoverOk(Reply):
 
     def __init__(self, txn_id: TxnId, status: SaveStatus,
                  accepted_ballot: Ballot, execute_at: Optional[Timestamp],
-                 deps: Deps, partial_txn: Optional[PartialTxn],
-                 committed_deps: Optional[Deps],
+                 latest_deps: LatestDeps, partial_txn: Optional[PartialTxn],
                  writes: Optional[Writes], result,
                  rejects_fast_path: bool,
                  earlier_committed_witness: Deps,
@@ -36,12 +36,11 @@ class RecoverOk(Reply):
         self.status = status
         self.accepted_ballot = accepted_ballot
         self.execute_at = execute_at
-        # deps: freshly calculated like a PreAccept vote — the recovery
-        # proposal deps if the fast path is adopted
-        self.deps = deps
+        # per-range KnownDeps-aware deps knowledge: local PreAccept-style
+        # calculations, Accept-round proposals with their ballots, and
+        # committed deps, merged range-wise across the quorum
+        self.latest_deps = latest_deps
         self.partial_txn = partial_txn
-        # committed_deps: the decided deps when status >= COMMITTED
-        self.committed_deps = committed_deps
         self.writes = writes
         self.result = result
         self.rejects_fast_path = rejects_fast_path
@@ -67,14 +66,6 @@ class RecoverOk(Reply):
                        if self.partial_txn is not None
                        and other.partial_txn is not None
                        else self.partial_txn or other.partial_txn)
-        committed_deps = None
-        if hi.status.is_at_least_committed:
-            # only union deps decided at the same executeAt
-            cds = [ok.committed_deps for ok in (self, other)
-                   if ok.committed_deps is not None
-                   and ok.execute_at == hi.execute_at]
-            if cds:
-                committed_deps = Deps.merge(cds)
         writes = (hi.writes.merge(lo.writes) if hi.writes is not None
                   else lo.writes)
         witness = self.earlier_committed_witness.with_(
@@ -83,7 +74,7 @@ class RecoverOk(Reply):
             other.earlier_no_witness).without(witness.contains)
         return RecoverOk(
             self.txn_id, hi.status, accepted_ballot, hi.execute_at,
-            self.deps.with_(other.deps), partial_txn, committed_deps,
+            self.latest_deps.merge(other.latest_deps), partial_txn,
             writes,
             hi.result if hi.result is not None else lo.result,
             self.rejects_fast_path or other.rejects_fast_path,
@@ -124,30 +115,39 @@ class BeginRecovery(TxnRequest):
         if outcome == C.AcceptOutcome.TRUNCATED:
             # genuinely invalidated or locally shed: report what we know
             return RecoverOk(self.txn_id, cmd.save_status, cmd.accepted_ballot,
-                             cmd.execute_at, Deps.NONE, None, None,
+                             cmd.execute_at, LatestDeps.EMPTY, None,
                              None, None, False, Deps.NONE, Deps.NONE)
 
         keys = self._local_keys(safe_store, cmd)
-        deps = Deps.NONE
+        local_deps = None
         rejects = False
         earlier_witness = Deps.NONE
         earlier_no_witness = Deps.NONE
+        known_deps = cmd.known().deps
+        if known_deps < KnownDeps.COMMITTED:
+            # no committed/decided deps held here: contribute a fresh local
+            # calculation — including for PRE_COMMITTED replicas, whose
+            # executeAt arrived by Propagate without deps
+            # (BeginRecovery.java:115-119 hasCommittedOrDecidedDeps gate)
+            local_deps = C.calculate_deps(safe_store, self.txn_id, keys,
+                                          before=self.txn_id)
         if not cmd.has_been(SaveStatus.PRE_COMMITTED):
-            # proposal deps + fast-path decipher predicates only matter
-            # pre-decision; a decided txn's recovery uses committed deps
-            deps = C.calculate_deps(safe_store, self.txn_id, keys,
-                                    before=self.txn_id)
+            # fast-path decipher predicates only matter pre-decision
             rejects = safe_store.rejects_fast_path(self.txn_id, keys)
             earlier_witness = safe_store.earlier_committed_witness(
                 self.txn_id, keys)
             earlier_no_witness = safe_store.earlier_accepted_no_witness(
                 self.txn_id, keys)
-        committed_deps = (cmd.stable_deps if cmd.stable_deps is not None
-                          else cmd.partial_deps) \
-            if cmd.has_been(SaveStatus.COMMITTED) else None
+        # coordinated = whatever a coordinator durably handed us: the Accept
+        # proposal (PROPOSED) or the commit's deps (COMMITTED/STABLE)
+        coordinated = (cmd.stable_deps if cmd.stable_deps is not None
+                       else cmd.partial_deps)
+        latest = LatestDeps.create(safe_store.ranges, known_deps,
+                                   cmd.accepted_ballot, coordinated,
+                                   local_deps)
         return RecoverOk(
             self.txn_id, cmd.save_status, cmd.accepted_ballot, cmd.execute_at,
-            deps, cmd.partial_txn, committed_deps, cmd.writes, cmd.result,
+            latest, cmd.partial_txn, cmd.writes, cmd.result,
             rejects, earlier_witness, earlier_no_witness)
 
     def _local_keys(self, safe_store, cmd):

@@ -6,17 +6,13 @@ route knowledge, and must either prove the fast path impossible (then kill
 the txn) or discover the route and hand off to recovery.
 """
 
-import pytest
-
 from accord_tpu.coordinate.errors import Invalidated
 from accord_tpu.coordinate.tracking import InvalidationTracker, RequestStatus
-from accord_tpu.impl.list_store import ListQuery, ListRead, ListUpdate
 from accord_tpu.local.status import SaveStatus
 from accord_tpu.messages.accept import Accept
 from accord_tpu.messages.preaccept import PreAccept
-from accord_tpu.primitives.keys import Key, Keys, Range, Route, RoutingKeys
-from accord_tpu.primitives.timestamp import Domain, TxnKind
-from accord_tpu.primitives.txn import Txn
+from accord_tpu.primitives.keys import Key, Range, Route, RoutingKeys
+from accord_tpu.primitives.timestamp import TxnKind
 from accord_tpu.sim.cluster import SimCluster
 from accord_tpu.topology.shard import Shard
 from accord_tpu.topology.topologies import Topologies

@@ -1,0 +1,134 @@
+"""LatestDeps: KnownDeps-aware range-wise recovery deps merging.
+
+Reference model: accord/primitives/LatestDeps.java — mixed-status quorums
+must resolve per range: committed knowledge wins outright, competing Accept
+proposals resolve by ballot, undecided ranges union local calculations.
+"""
+
+import pytest
+
+from accord_tpu.local.status import KnownDeps
+from accord_tpu.primitives.deps import Deps, KeyDeps
+from accord_tpu.primitives.keys import Key, Range, Ranges
+from accord_tpu.primitives.latest_deps import LatestDeps, LatestDepsEntry
+from accord_tpu.primitives.timestamp import Ballot, Domain, TxnId, TxnKind
+
+
+def tid(hlc, node=1):
+    return TxnId.create(1, hlc, TxnKind.WRITE, Domain.KEY, node)
+
+
+def ballot(hlc, node=1):
+    return Ballot(1, hlc, 0, node)
+
+
+def deps_of(*pairs):
+    """deps_of((key_token, txn_id), ...)"""
+    model = {}
+    for k, t in pairs:
+        model.setdefault(Key(k), set()).add(t)
+    return Deps(KeyDeps.of(model))
+
+
+def ids(deps):
+    return set(deps.txn_id_set())
+
+
+class TestLatestDepsMerge:
+    def test_committed_beats_proposed(self):
+        """A committed range's deps win over a competing proposal — the
+        proposal is a dead Accept round the commit superseded."""
+        committed = LatestDeps.create(
+            Ranges.of((0, 100)), KnownDeps.COMMITTED, ballot(5),
+            deps_of((10, tid(1))), None)
+        proposed = LatestDeps.create(
+            Ranges.of((0, 100)), KnownDeps.PROPOSED, ballot(9),
+            deps_of((10, tid(2))), deps_of((10, tid(3))))
+        for merged in (committed.merge(proposed), proposed.merge(committed)):
+            deps, sufficient = merged.merge_commit(use_local=False)
+            assert ids(deps) == {tid(1)}
+            assert sufficient == Ranges.of((0, 100))
+
+    def test_proposed_resolves_by_ballot(self):
+        """Two Accept-round proposals on the same range: the higher ballot's
+        coordinated deps are the ones recovery must re-propose."""
+        lo = LatestDeps.create(Ranges.of((0, 100)), KnownDeps.PROPOSED,
+                               ballot(3), deps_of((10, tid(1))), None)
+        hi = LatestDeps.create(Ranges.of((0, 100)), KnownDeps.PROPOSED,
+                               ballot(7), deps_of((10, tid(2))), None)
+        for merged in (lo.merge(hi), hi.merge(lo)):
+            assert ids(merged.merge_proposal()) == {tid(2)}
+
+    def test_unknown_unions_locals(self):
+        """Nothing proposed anywhere: the proposal is the union of every
+        replica's local calculation (the PreAccept-equivalent vote)."""
+        a = LatestDeps.create(Ranges.of((0, 100)), KnownDeps.UNKNOWN,
+                              Ballot.ZERO, None, deps_of((10, tid(1))))
+        b = LatestDeps.create(Ranges.of((0, 100)), KnownDeps.UNKNOWN,
+                              Ballot.ZERO, None, deps_of((20, tid(2))))
+        assert ids(a.merge(b).merge_proposal()) == {tid(1), tid(2)}
+
+    def test_mixed_ranges_resolve_independently(self):
+        """Replica A committed [0,100) but knows nothing of [100,200);
+        replica B holds a proposal there: each range resolves by its own
+        knowledge level."""
+        a = LatestDeps.create(Ranges.of((0, 100)), KnownDeps.COMMITTED,
+                              ballot(2), deps_of((10, tid(1))), None)
+        a = a.merge(LatestDeps.create(Ranges.of((100, 200)),
+                                      KnownDeps.UNKNOWN, Ballot.ZERO, None,
+                                      deps_of((150, tid(4)))))
+        b = LatestDeps.create(Ranges.of((100, 200)), KnownDeps.PROPOSED,
+                              ballot(5), deps_of((150, tid(2))),
+                              deps_of((150, tid(3))))
+        merged = a.merge(b)
+        # proposal path: committed range contributes nothing to re-proposal,
+        # [100,200) uses the proposal
+        assert ids(merged.merge_proposal()) == {tid(2)}
+        # commit path without fast-path equivalence: only [0,100) sufficient
+        deps, sufficient = merged.merge_commit(use_local=False)
+        assert ids(deps) == {tid(1)}
+        assert sufficient == Ranges.of((0, 100))
+
+    def test_fast_path_commit_accepts_locals(self):
+        """executeAt == txnId: replicas' local calculations are exactly what
+        the dead coordinator would have committed, so undecided ranges are
+        sufficient too (LatestDeps.Merge.forCommit DepsUnknown arm)."""
+        a = LatestDeps.create(Ranges.of((0, 100)), KnownDeps.COMMITTED,
+                              ballot(2), deps_of((10, tid(1))), None)
+        a = a.merge(LatestDeps.create(Ranges.of((100, 200)),
+                                      KnownDeps.UNKNOWN, Ballot.ZERO, None,
+                                      deps_of((150, tid(4)))))
+        deps, sufficient = a.merge_commit(use_local=True)
+        assert ids(deps) == {tid(1), tid(4)}
+        assert sufficient == Ranges.of((0, 200))
+
+    def test_deps_sliced_to_their_interval(self):
+        """An entry's deps may span beyond its interval (they are not
+        pre-sliced); extraction must clip them so a range another replica
+        decided is not polluted."""
+        wide = deps_of((10, tid(1)), (150, tid(2)))
+        a = LatestDeps.create(Ranges.of((0, 100)), KnownDeps.UNKNOWN,
+                              Ballot.ZERO, None, wide)
+        b = LatestDeps.create(Ranges.of((100, 200)), KnownDeps.COMMITTED,
+                              ballot(4), deps_of((150, tid(3))), None)
+        merged = a.merge(b)
+        prop = merged.merge_proposal()
+        assert ids(prop) == {tid(1)}  # tid(2) lives in b's committed range
+
+    def test_knowledge_free_range_is_never_sufficient(self):
+        """A range where every replica precommitted via a depless Propagate
+        (UNKNOWN, no coordinated, no locals) must stay insufficient even for
+        a fast-path commit — otherwise recovery commits empty deps and
+        conflicting predecessors are never ordered."""
+        bare = LatestDeps.create(Ranges.of((0, 100)), KnownDeps.UNKNOWN,
+                                 Ballot.ZERO, None, None)
+        deps, sufficient = bare.merge_commit(use_local=True)
+        assert sufficient.is_empty
+        assert deps == Deps.NONE
+
+    def test_empty_merges_are_identity(self):
+        a = LatestDeps.create(Ranges.of((0, 100)), KnownDeps.UNKNOWN,
+                              Ballot.ZERO, None, deps_of((10, tid(1))))
+        assert LatestDeps.EMPTY.merge(a) == a
+        assert a.merge(LatestDeps.EMPTY) == a
+        assert LatestDeps.EMPTY.merge_proposal() == Deps.NONE
