@@ -19,6 +19,7 @@ from accord_tpu.messages.checkstatus import (CheckStatus, CheckStatusNack,
                                              CheckStatusOk, IncludeInfo)
 from accord_tpu.messages.propagate import Propagate
 from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.timestamp import NONE as TS_NONE
 from accord_tpu.primitives.timestamp import TxnId
 from accord_tpu.utils.async_chains import AsyncResult
 
@@ -113,6 +114,81 @@ def find_route(node, txn_id: TxnId, some_participants) -> AsyncResult:
         routing = some_participants.as_routing()
         probe = Route(routing[0], keys=routing, is_full=False)
     return check_shards(node, txn_id, probe, IncludeInfo.ALL)
+
+
+class _FetchMaxConflict(Callback):
+    """Quorum-per-shard max-conflict fetch (coordinate/FetchMaxConflict.java).
+    If any replica reports a later epoch than we queried at, the ownership of
+    `route` may have moved — re-run against the newer topology so the answer
+    covers every possible witness."""
+
+    def __init__(self, node, route: Route, participants, execution_epoch: int,
+                 result: AsyncResult, seen_conflict=TS_NONE):
+        self.node = node
+        self.route = route
+        self.participants = participants
+        self.execution_epoch = execution_epoch
+        self.result = result
+        self.tracker: Optional[QuorumTracker] = None
+        # carry conflicts witnessed by earlier rounds across epoch-chase
+        # retries — the old owners a later round no longer contacts may be
+        # the only replicas that ever saw them (max is monotone, so stale
+        # first-round answers remain sound)
+        self.max_conflict = seen_conflict
+        self.latest_epoch = execution_epoch
+        self.done = False
+
+    def start(self) -> None:
+        from accord_tpu.messages.maxconflict import GetMaxConflict
+        topologies = self.node.topology.with_unsynced_epochs(
+            self.route.participants(), self.execution_epoch,
+            self.execution_epoch)
+        self.tracker = QuorumTracker(topologies)
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, self.route)
+            if scope is None:
+                continue
+            sliced = self.participants.slice(scope.covering())
+            self.node.send(to, GetMaxConflict(scope, sliced,
+                                              self.execution_epoch),
+                           callback=self)
+
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        self.max_conflict = max(self.max_conflict, reply.max_conflict)
+        self.latest_epoch = max(self.latest_epoch, reply.latest_epoch)
+        if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
+            self.done = True
+            if self.latest_epoch > self.execution_epoch:
+                retry_epoch = self.latest_epoch
+                seen = self.max_conflict
+                self.node.with_epoch(
+                    retry_epoch,
+                    lambda: _FetchMaxConflict(self.node, self.route,
+                                              self.participants, retry_epoch,
+                                              self.result,
+                                              seen_conflict=seen).start())
+                return
+            self.result.try_success(self.max_conflict)
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        if self.tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self.done = True
+            self.result.try_failure(failure if isinstance(failure, Timeout)
+                                    else Exhausted(repr(failure)))
+
+
+def fetch_max_conflict(node, route: Route, participants) -> AsyncResult:
+    """Highest conflicting timestamp any quorum witnessed over `participants`
+    (Keys or Ranges), chasing epoch bumps; resolves to a Timestamp
+    (FetchMaxConflict.fetchMaxConflict). Bootstrap uses this to fence reads
+    of newly-owned ranges above every pre-handoff conflict."""
+    result: AsyncResult = AsyncResult()
+    _FetchMaxConflict(node, route, participants, node.epoch, result).start()
+    return result
 
 
 def maybe_recover(node, txn_id: TxnId, route: Route,

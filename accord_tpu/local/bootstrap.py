@@ -136,14 +136,38 @@ class Bootstrap(Callback):
         if self.done:
             return
         self.done = True
+        self._fetch_max_conflict()
+
+    def _fetch_max_conflict(self) -> None:
+        """Before declaring the ranges readable, learn the highest conflict
+        any quorum witnessed for them (reference Bootstrap.java:234
+        FetchMaxConflict): raising our HLC and MaxConflicts above it keeps
+        every timestamp we mint for the new ranges after the handoff point."""
+        from accord_tpu.coordinate.fetch import fetch_max_conflict
+        from accord_tpu.primitives.keys import Route, RoutingKey
+        route = Route(RoutingKey(self.ranges[0].start), ranges=self.ranges,
+                      is_full=False)
+        fetch_max_conflict(self.node, route, self.ranges).add_callback(
+            self._on_max_conflict)
+
+    def _on_max_conflict(self, max_conflict, failure) -> None:
+        if failure is not None:
+            self.node.scheduler.once(self.RETRY_DELAY_S,
+                                     self._fetch_max_conflict)
+            return
         from accord_tpu.local import commands as C
         from accord_tpu.local.store import PreLoadContext
+        from accord_tpu.primitives.timestamp import NONE as TS_NONE
 
+        if max_conflict > TS_NONE:
+            self.node.on_remote_timestamp(max_conflict)
         for store in self.node.command_stores.intersecting(self.ranges):
             owned = self.ranges.slice(store.ranges)
             if owned.is_empty:
                 continue
             store.redundant_before.set_bootstrapped_at(owned, self.sp.txn_id)
+            if max_conflict > TS_NONE:
+                store.max_conflicts.update(owned, max_conflict)
             store.mark_safe_to_read(owned)
             # deps below the fence are now satisfied by the snapshot:
             # re-evaluate everything blocked on them

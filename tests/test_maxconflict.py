@@ -1,0 +1,68 @@
+"""GetMaxConflict / fetch_max_conflict: the conflict-watermark query round.
+
+Reference model: accord/messages/GetMaxConflict.java +
+coordinate/FetchMaxConflict.java — a quorum consensus on the highest
+timestamp that conflicts with a selection, used by bootstrap to fence
+newly-owned ranges above every pre-handoff conflict.
+"""
+
+from accord_tpu.coordinate.fetch import fetch_max_conflict
+from accord_tpu.primitives.keys import Keys, Route
+from accord_tpu.primitives.timestamp import NONE as TS_NONE
+from accord_tpu.primitives.timestamp import Domain, TxnKind
+from accord_tpu.sim.cluster import SimCluster
+
+from tests.test_recover import run_txn, rw_txn
+
+
+def key_route(*tokens):
+    from accord_tpu.primitives.keys import RoutingKeys
+    keys = RoutingKeys.of(*tokens)
+    return Route(keys[0], keys=keys, is_full=False)
+
+
+def fetch(cluster, node_id, route, participants):
+    res = fetch_max_conflict(cluster.node(node_id), route, participants)
+    assert cluster.process_until(lambda: res.is_done)
+    assert res.failure() is None, res.failure()
+    return res.value()
+
+
+class TestFetchMaxConflict:
+    def test_untouched_keys_have_no_conflict(self):
+        cluster = SimCluster(n_nodes=3, seed=31)
+        mc = fetch(cluster, 1, key_route(500), Keys.of(500))
+        assert mc == TS_NONE
+
+    def test_reports_executed_write(self):
+        """After a write on key 10 commits, the quorum's max conflict for 10
+        is at least that write's executeAt — and strictly above NONE."""
+        cluster = SimCluster(n_nodes=3, seed=32)
+        run_txn(cluster, 1, rw_txn([], {10: 7}))
+        mc = fetch(cluster, 2, key_route(10), Keys.of(10))
+        assert mc > TS_NONE
+        # an untouched neighbour key stays clean
+        assert fetch(cluster, 2, key_route(11), Keys.of(11)) == TS_NONE
+
+    def test_max_over_multiple_writes(self):
+        """The answer is the max across keys: a later write on key 20
+        dominates an earlier one on key 10 when both are queried."""
+        cluster = SimCluster(n_nodes=3, seed=33)
+        run_txn(cluster, 1, rw_txn([], {10: 1}))
+        mc_10 = fetch(cluster, 1, key_route(10), Keys.of(10))
+        run_txn(cluster, 1, rw_txn([], {20: 2}))
+        mc_20 = fetch(cluster, 1, key_route(20), Keys.of(20))
+        assert mc_20 > mc_10 > TS_NONE
+        both = fetch(cluster, 3, key_route(10, 20), Keys.of(10, 20))
+        assert both == mc_20
+
+    def test_fresh_txns_mint_above_fetched_conflict(self):
+        """The fence property bootstrap relies on: any txn started after
+        observing the fetched max conflict executes above it."""
+        cluster = SimCluster(n_nodes=3, seed=34)
+        run_txn(cluster, 1, rw_txn([], {10: 1}))
+        mc = fetch(cluster, 2, key_route(10), Keys.of(10))
+        node = cluster.node(2)
+        node.on_remote_timestamp(mc)
+        txn_id = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+        assert txn_id.as_timestamp() > mc
