@@ -106,14 +106,8 @@ def find_route(node, txn_id: TxnId, some_participants) -> AsyncResult:
     """Discover a txn's route by asking the shards of whatever participants
     we learned of it through (FindRoute/FindSomeRoute — `someUnseekables`).
     Resolves to the merged CheckStatusOk (whose .route may still be None)."""
-    from accord_tpu.primitives.keys import Ranges, RoutingKey
-    if isinstance(some_participants, Ranges):
-        probe = Route(RoutingKey(some_participants[0].start),
-                      ranges=some_participants, is_full=False)
-    else:
-        routing = some_participants.as_routing()
-        probe = Route(routing[0], keys=routing, is_full=False)
-    return check_shards(node, txn_id, probe, IncludeInfo.ALL)
+    return check_shards(node, txn_id, Route.probe(some_participants),
+                        IncludeInfo.ALL)
 
 
 class _FetchMaxConflict(Callback):

@@ -144,11 +144,9 @@ class Bootstrap(Callback):
         FetchMaxConflict): raising our HLC and MaxConflicts above it keeps
         every timestamp we mint for the new ranges after the handoff point."""
         from accord_tpu.coordinate.fetch import fetch_max_conflict
-        from accord_tpu.primitives.keys import Route, RoutingKey
-        route = Route(RoutingKey(self.ranges[0].start), ranges=self.ranges,
-                      is_full=False)
-        fetch_max_conflict(self.node, route, self.ranges).add_callback(
-            self._on_max_conflict)
+        from accord_tpu.primitives.keys import Route
+        fetch_max_conflict(self.node, Route.probe(self.ranges),
+                           self.ranges).add_callback(self._on_max_conflict)
 
     def _on_max_conflict(self, max_conflict, failure) -> None:
         if failure is not None:

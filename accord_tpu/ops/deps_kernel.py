@@ -11,13 +11,17 @@ Device formulation over the rank encoding (ops/encode.py):
                & witnesses(kind(b), kind(e))  # txn-kind conflict matrix
                & status(e) in 1..6            # not TRANSITIVELY_KNOWN/INVALID
 Transitive elision (the reference's pruning below the max committed write):
-    bound[b, k] = max eat_rank over committed WRITE entries at key k with
-                  eat_rank < rank(b)          # scatter-max over the key axis
-    dep[b, e]  = base[b, e] & ~(committed(e) & eat_rank(e) < bound[b, key(e)])
-The [B, E] tile is fused broadcast-compares on the VPU plus one scatter-max
-and one gather; XLA fuses the lot into a single pass over HBM.  The in-batch
-conflict graph (for the wavefront resolver) is one matmul on the MXU:
-share[b, b'] = touches @ touches.T > 0.
+the scalar bound "max committed-write executeAt < rank(b) at key(e)" exceeds
+eat(e) iff SOME committed write at the key executes in (eat(e), rank(b)) —
+iff the SMALLEST committed-write eat strictly above eat(e) does.  That
+successor, succ_w[e], is independent of the querying txn, so the whole bound
+collapses to a per-entry precomputation (one [E] two-key sort + segmented
+scan) followed by a broadcast compare:
+    elided[b, e] = committed(e) & eat(e) < succ_w(e) < rank(b)
+No [B, E] scatter ever materialises.  The remaining [B, E] tile is fused
+broadcast-compares on the VPU plus one gather; XLA fuses the lot into a
+single pass over HBM.  The in-batch conflict graph (for the wavefront
+resolver) is one matmul on the MXU: share[b, b'] = touches @ touches.T > 0.
 """
 
 from __future__ import annotations
@@ -35,14 +39,50 @@ _COMMITTED = 4
 _APPLIED = 6
 
 
-@functools.partial(jax.jit, static_argnames=("num_keys",))
+_BIG = jnp.iinfo(jnp.int32).max
+
+
+def _successor_write_eat(entry_key: jax.Array, entry_eat_rank: jax.Array,
+                         write_eat: jax.Array) -> jax.Array:
+    """succ_w[e] = smallest committed-write eat_rank strictly above
+    entry e's eat_rank at the same key (+inf when none).
+
+    Entries sorted by (key, eat) put each key's history contiguous and
+    ascending, so the successor is a segmented exclusive suffix-min of the
+    write eats — computed as a segmented inclusive prefix-min of the
+    one-shifted reversed array (classic (value, reset-flag) associative
+    segmented scan)."""
+    # stable two-pass lexsort by (key, eat)
+    o1 = jnp.argsort(entry_eat_rank)
+    o2 = jnp.argsort(entry_key[o1])
+    order = o1[o2]
+    k_s = entry_key[order]
+    w_rev = write_eat[order][::-1]
+    k_rev = k_s[::-1]
+    prev_same = jnp.concatenate(
+        [jnp.zeros((1,), bool), k_rev[1:] == k_rev[:-1]])
+    shifted = jnp.where(
+        prev_same,
+        jnp.concatenate([jnp.full((1,), _BIG, jnp.int32), w_rev[:-1]]),
+        _BIG)
+
+    def seg_min(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, jnp.minimum(av, bv)), af | bf
+
+    vals, _ = jax.lax.associative_scan(seg_min, (shifted, ~prev_same))
+    succ_sorted = vals[::-1]
+    return jnp.zeros_like(succ_sorted).at[order].set(succ_sorted)
+
+
+@functools.partial(jax.jit, static_argnames=())
 def batched_active_deps(entry_rank: jax.Array, entry_eat_rank: jax.Array,
                         entry_key: jax.Array, entry_status: jax.Array,
                         entry_kind: jax.Array,
                         txn_rank: jax.Array, txn_witness_mask: jax.Array,
-                        touches: jax.Array, *, num_keys: int = 0):
+                        touches: jax.Array):
     """-> (dep_mask[B, E] bool, dep_count[B] i32 — per-(txn,key) edges)."""
-    k = touches.shape[1] if num_keys == 0 else num_keys
     touch_e = jnp.take(touches, entry_key, axis=1)            # [B, E] gather
     earlier = entry_rank[None, :] < txn_rank[:, None]          # [B, E]
     witnessed = ((txn_witness_mask[:, None] >> entry_kind[None, :]) & 1) == 1
@@ -51,18 +91,17 @@ def batched_active_deps(entry_rank: jax.Array, entry_eat_rank: jax.Array,
         & (entry_status != STATUS_INACTIVE)
     base = touch_e & earlier & witnessed & active[None, :]
 
-    # transitive elision bound: per (txn, key) the max executeAt rank among
-    # committed writes executing strictly before the querying txn
+    # transitive elision: e is covered iff a committed write at its key
+    # executes strictly between e and the querying txn; the earliest such
+    # write is txn-independent (succ_w), leaving a broadcast compare
     committed = (entry_status >= _COMMITTED) & (entry_status <= _APPLIED) \
         & (entry_rank >= 0)
     is_write = ((WRITE_KIND_MASK >> entry_kind) & 1) == 1
-    exec_earlier = entry_eat_rank[None, :] < txn_rank[:, None]   # [B, E]
-    cand = jnp.where(committed[None, :] & is_write[None, :] & exec_earlier,
-                     entry_eat_rank[None, :], -1)                # [B, E]
-    bound_bk = jnp.full((touches.shape[0], k), -1, jnp.int32)
-    bound_bk = bound_bk.at[:, entry_key].max(cand)               # scatter-max
-    bound_be = jnp.take(bound_bk, entry_key, axis=1)             # [B, E]
-    elided = committed[None, :] & (entry_eat_rank[None, :] < bound_be)
+    write_eat = jnp.where(committed & is_write, entry_eat_rank, _BIG)
+    succ_w = _successor_write_eat(entry_key, entry_eat_rank, write_eat)
+    strictly_above = succ_w > entry_eat_rank  # tie-guard; eats unique per key
+    elided = committed[None, :] & strictly_above[None, :] \
+        & (succ_w[None, :] < txn_rank[:, None])
 
     dep = base & ~elided
     return dep, dep.sum(axis=1, dtype=jnp.int32)

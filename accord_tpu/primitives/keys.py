@@ -373,6 +373,18 @@ class Route:
     def of_ranges(cls, home_key: RoutingKey, ranges: Ranges) -> "Route":
         return cls(home_key, ranges=ranges)
 
+    @classmethod
+    def probe(cls, participants) -> "Route":
+        """Partial route over bare participants (Keys/RoutingKeys/Ranges),
+        for rounds that only need to reach the owning shards — route
+        discovery (FindRoute's someUnseekables) and watermark queries. The
+        nominal home key is the first participant."""
+        if isinstance(participants, Ranges):
+            return cls(RoutingKey(participants[0].start),
+                       ranges=participants, is_full=False)
+        routing = participants.as_routing()
+        return cls(routing[0], keys=routing, is_full=False)
+
     @property
     def is_key_domain(self) -> bool:
         return self.keys is not None
