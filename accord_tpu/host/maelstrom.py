@@ -1,0 +1,270 @@
+"""Maelstrom protocol host: one Accord node as a stdin/stdout JSON process.
+
+Reference: accord-maelstrom/Main.java:145 — reads newline-delimited JSON
+envelopes {"src","dest","body"} from stdin, writes them to stdout. Supports:
+  * init            — builds the Node; topology derives deterministically
+                      from the init node list so every process agrees
+  * txn             — Maelstrom txn-list-append workload: micro-ops
+                      [["r", k, null], ["append", k, v], ...] become one
+                      Accord transaction over the list-register data plane
+  * accord          — inter-node Accord traffic, wire.py-encoded; request
+                      callbacks ride msg_id/in_reply_to like the reference's
+                      Packet/MaelstromReplyContext
+
+Run: python -m accord_tpu.host.maelstrom
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import zlib
+from typing import Dict, Optional
+
+from accord_tpu.api.spi import Agent, MessageSink
+from accord_tpu.host.rt import RealTimeScheduler
+from accord_tpu.host.wire import decode_message, encode_message
+from accord_tpu.impl.list_store import (ListQuery, ListRead, ListResult,
+                                        ListStore, ListUpdate)
+from accord_tpu.messages.base import Reply, Request
+from accord_tpu.primitives.keys import Key, Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+from accord_tpu.utils.random_source import RandomSource
+
+TOKEN_SPAN = 1 << 31
+
+
+def node_num(name: str) -> int:
+    """'n3' -> 3; anything else hashes stably."""
+    if name.startswith("n") and name[1:].isdigit():
+        return int(name[1:])
+    return (zlib.crc32(name.encode()) % 1_000_000) + 1_000
+
+
+def key_token(k) -> int:
+    if isinstance(k, bool) or not isinstance(k, int):
+        return zlib.crc32(str(k).encode()) % TOKEN_SPAN
+    return k % TOKEN_SPAN
+
+
+class HostAgent(Agent):
+    def on_uncaught_exception(self, failure: BaseException) -> None:
+        print(f"uncaught: {failure!r}", file=sys.stderr, flush=True)
+
+    def on_handled_exception(self, failure: BaseException) -> None:
+        pass
+
+    def pre_accept_timeout(self) -> float:
+        return 1.0
+
+    def empty_txn(self, kind: TxnKind, keys_or_ranges) -> Txn:
+        return Txn(kind, keys_or_ranges)
+
+
+class MaelstromSink(MessageSink):
+    """MessageSink writing Maelstrom envelopes (reference Wrapper/Packet)."""
+
+    def __init__(self, host: "MaelstromHost"):
+        self.host = host
+        self._seq = 0
+        self._callbacks: Dict[int, object] = {}
+
+    def send(self, to: int, request: Request) -> None:
+        self.host.emit_node(to, {"type": "accord",
+                                 "payload": encode_message(request)})
+
+    def send_with_callback(self, to: int, request: Request, callback,
+                           executor=None) -> None:
+        self._seq += 1
+        self._callbacks[self._seq] = callback
+        self.host.emit_node(to, {"type": "accord", "msg_id": self._seq,
+                                 "payload": encode_message(request)})
+
+    def reply(self, to: int, reply_context, reply: Reply) -> None:
+        if reply_context is None:
+            return
+        self.host.emit_node(to, {"type": "accord",
+                                 "in_reply_to": reply_context,
+                                 "payload": encode_message(reply)})
+
+    def deliver_reply(self, msg_id: int, from_id: int, reply) -> None:
+        callback = self._callbacks.pop(msg_id, None)
+        if callback is not None:
+            callback.deliver(reply)
+
+
+class MaelstromHost:
+    def __init__(self, stdin=None, stdout=None, rf: Optional[int] = None):
+        self.stdin = stdin if stdin is not None else sys.stdin
+        self.stdout = stdout if stdout is not None else sys.stdout
+        self.rf = rf
+        self.node = None
+        self.node_name = ""
+        self.names: Dict[int, str] = {}
+        self.scheduler = RealTimeScheduler()
+        self.sink = MaelstromSink(self)
+        self._msg_seq = 0
+        self.running = True
+        self._pre_init: list = []
+
+    # ------------------------------------------------------------- output --
+    def _emit(self, dest: str, body: dict) -> None:
+        print(json.dumps({"src": self.node_name, "dest": dest,
+                          "body": body}),
+              file=self.stdout, flush=True)
+
+    def emit_node(self, to: int, body: dict) -> None:
+        self._emit(self.names.get(to, f"n{to}"), body)
+
+    # -------------------------------------------------------------- wiring --
+    def _build_node(self, name: str, node_names) -> None:
+        from accord_tpu.local.node import Node
+        self.node_name = name
+        my_id = node_num(name)
+        ids = sorted(node_num(n) for n in node_names)
+        self.names = {node_num(n): n for n in node_names}
+        rf = self.rf if self.rf is not None else min(3, len(ids))
+        width = TOKEN_SPAN // len(ids)
+        shards = []
+        for i in range(len(ids)):
+            start = i * width
+            end = TOKEN_SPAN if i == len(ids) - 1 else (i + 1) * width
+            replicas = [ids[(i + j) % len(ids)] for j in range(rf)]
+            shards.append(Shard(Range(start, end), replicas))
+        topology = Topology(1, shards)
+        agent = HostAgent()
+        self.scheduler.on_error = agent.on_uncaught_exception
+        self.node = Node(my_id, self.sink, agent, self.scheduler,
+                         ListStore(my_id), RandomSource(my_id),
+                         num_shards=1,
+                         now_us=lambda: int(time.time() * 1e6))
+        self.node.on_topology_update(topology)
+
+    # ------------------------------------------------------------ handlers --
+    def handle(self, envelope: dict) -> None:
+        body = envelope.get("body", {})
+        typ = body.get("type")
+        src = envelope.get("src", "")
+        if typ == "init":
+            self._build_node(body["node_id"], body["node_ids"])
+            self._emit(src, {"type": "init_ok",
+                             "in_reply_to": body.get("msg_id")})
+            replay, self._pre_init = self._pre_init, []
+            for env in replay:
+                self.handle(env)
+        elif self.node is None:
+            # a faster peer's traffic raced our init: hold it
+            self._pre_init.append(envelope)
+        elif typ == "txn":
+            self._handle_txn(src, body)
+        elif typ == "accord":
+            self._handle_accord(src, body)
+        elif typ == "final_read":
+            # harness-only: linearizable read of a key set via a READ txn
+            self._handle_txn(src, {
+                "msg_id": body.get("msg_id"),
+                "type": "txn",
+                "txn": [["r", k, None] for k in body["keys"]]})
+
+    def _handle_txn(self, client: str, body: dict) -> None:
+        ops = body["txn"]
+        msg_id = body.get("msg_id")
+        reads = []
+        appends: Dict[Key, int] = {}
+        for op, k, v in ops:
+            token = key_token(k)
+            if op == "r":
+                reads.append(Key(token))
+            elif op == "append":
+                if Key(token) in appends:
+                    # the list-register data plane carries one append per
+                    # key per txn; acking a collapsed second append would be
+                    # a lost acknowledged write
+                    self._emit(client, {"type": "error",
+                                        "in_reply_to": msg_id, "code": 10,
+                                        "text": f"duplicate append to {k}"})
+                    return
+                appends[Key(token)] = v
+            else:
+                self._emit(client, {"type": "error", "in_reply_to": msg_id,
+                                    "code": 10,
+                                    "text": f"unsupported op {op}"})
+                return
+        keys = Keys(set(reads) | set(appends))
+        txn = Txn(TxnKind.WRITE if appends else TxnKind.READ, keys,
+                  read=ListRead(Keys(reads)) if reads else None,
+                  query=ListQuery(),
+                  update=ListUpdate(appends) if appends else None)
+
+        def done(result, failure):
+            if failure is not None:
+                self._emit(client, {"type": "error", "in_reply_to": msg_id,
+                                    "code": 11, "text": repr(failure)})
+                return
+            out = []
+            values = (result.read_values
+                      if isinstance(result, ListResult) else {})
+            for op, k, v in ops:
+                if op == "r":
+                    got = values.get(Key(key_token(k)))
+                    out.append([op, k, list(got) if got is not None else []])
+                else:
+                    out.append([op, k, v])
+            self._emit(client, {"type": "txn_ok", "in_reply_to": msg_id,
+                                "txn": out})
+
+        self.node.coordinate(txn).add_callback(done)
+
+    def _handle_accord(self, src: str, body: dict) -> None:
+        payload = decode_message(body["payload"])
+        from_id = node_num(src)
+        if "in_reply_to" in body:
+            self.sink.deliver_reply(body["in_reply_to"], from_id, payload)
+        else:
+            reply_context = body.get("msg_id")
+            self.node.receive(payload, from_id, reply_context)
+
+    # ---------------------------------------------------------------- loop --
+    def run(self) -> None:
+        """Single-threaded core: a reader thread only enqueues stdin lines
+        (select+readline over buffered pipes loses lines parked in the
+        Python-side buffer); the node is touched exclusively here."""
+        import queue
+        import threading
+        lines: "queue.Queue[Optional[str]]" = queue.Queue()
+
+        def reader():
+            for line in self.stdin:
+                lines.put(line)
+            lines.put(None)
+
+        threading.Thread(target=reader, daemon=True).start()
+        while self.running:
+            deadline = self.scheduler.next_deadline()
+            timeout = (max(0.0, deadline - time.monotonic())
+                       if deadline is not None else 0.5)
+            try:
+                line = lines.get(timeout=min(timeout, 0.5) or 0.01)
+            except queue.Empty:
+                line = ""
+            if line is None:
+                break
+            if line and line.strip():
+                try:
+                    self.handle(json.loads(line))
+                except Exception as e:  # noqa: BLE001
+                    print(f"handle error: {e!r} on {line[:200]}",
+                          file=sys.stderr, flush=True)
+            self.scheduler.run_due()
+
+
+def main():
+    MaelstromHost().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,254 @@
+"""Black-box cluster runner over real Maelstrom host subprocesses.
+
+Reference: accord-maelstrom's Cluster.java (the in-JVM runner driving nodes
+through the same JSON wire format Maelstrom itself would use). Ours goes one
+step further out of the box: each node is a separate OS process running
+`python -m accord_tpu.host.maelstrom`, the runner routes envelopes between
+their stdios, plays a randomized append/read workload as Maelstrom clients,
+and feeds the observed results to the burn test's strict-serializability
+verifier (sim/verify.py) with final states obtained through ordinary
+linearizable read transactions — fully black-box.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from accord_tpu.host.maelstrom import key_token
+from accord_tpu.sim.verify import Observation, StrictSerializabilityVerifier
+
+
+class HostProcess:
+    """One node subprocess; a reader thread enqueues its stdout lines."""
+
+    def __init__(self, name: str, inbox: "queue.Queue",
+                 extra_env: Optional[dict] = None):
+        import os
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")  # hosts never need the chip
+        if extra_env:
+            env.update(extra_env)
+        self.name = name
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "accord_tpu.host.maelstrom"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, bufsize=1, env=env)
+        self.stderr_tail: List[str] = []
+
+        def reader():
+            for line in self.proc.stdout:
+                inbox.put((name, line))
+
+        def drain_stderr():
+            # never let the child block on a full stderr pipe; keep a tail
+            # for diagnostics
+            for line in self.proc.stderr:
+                self.stderr_tail.append(line.rstrip())
+                del self.stderr_tail[:-50]
+
+        threading.Thread(target=reader, daemon=True).start()
+        threading.Thread(target=drain_stderr, daemon=True).start()
+
+    def send(self, envelope: dict) -> None:
+        self.proc.stdin.write(json.dumps(envelope) + "\n")
+        self.proc.stdin.flush()
+
+    def close(self) -> None:
+        try:
+            self.proc.stdin.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+
+class MaelstromRunner:
+    """Drives N host processes; acts as all Maelstrom clients at once."""
+
+    def __init__(self, n_nodes: int = 3, seed: int = 0):
+        self.names = [f"n{i + 1}" for i in range(n_nodes)]
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.procs: Dict[str, HostProcess] = {
+            name: HostProcess(name, self.inbox) for name in self.names}
+        self.seed = seed
+        self._msg_seq = 0
+        self.pending: Dict[int, dict] = {}   # msg_id -> op record
+        self.results: List[dict] = []
+        self.init_acks: set = set()
+
+    # ----------------------------------------------------------- plumbing --
+    def _route(self, envelope: dict) -> None:
+        dest = envelope.get("dest", "")
+        body = envelope.get("body", {})
+        if body.get("type") == "init_ok":
+            self.init_acks.add(envelope.get("src"))
+            return
+        if dest in self.procs:
+            self.procs[dest].send(envelope)
+        elif dest.startswith("c"):
+            rec = self.pending.pop(body.get("in_reply_to"), None)
+            if rec is not None:
+                rec["reply"] = body
+                rec["end_us"] = int(time.monotonic() * 1e6)
+                self.results.append(rec)
+
+    def pump(self, timeout: float = 0.05) -> int:
+        handled = 0
+        try:
+            name, line = self.inbox.get(timeout=timeout)
+        except queue.Empty:
+            return 0
+        while True:
+            try:
+                self._route(json.loads(line))
+                handled += 1
+            except json.JSONDecodeError:
+                print(f"bad json from {name}: {line[:200]}", file=sys.stderr)
+            try:
+                name, line = self.inbox.get_nowait()
+            except queue.Empty:
+                return handled
+
+    def pump_until(self, predicate, deadline_s: float = 60.0) -> bool:
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end:
+            if predicate():
+                return True
+            self.pump()
+        return predicate()
+
+    # ------------------------------------------------------------- client --
+    def init_all(self) -> None:
+        for name, hp in self.procs.items():
+            self._msg_seq += 1
+            hp.send({"src": "c0", "dest": name,
+                     "body": {"type": "init", "msg_id": self._msg_seq,
+                              "node_id": name, "node_ids": self.names}})
+        ok = self.pump_until(
+            lambda: len(self.init_acks) == len(self.names), 30.0)
+        assert ok, f"init timed out: {sorted(self.init_acks)}"
+
+    def submit_txn(self, client: str, ops: list, to: Optional[str] = None
+                   ) -> int:
+        self._msg_seq += 1
+        msg_id = self._msg_seq
+        dest = to if to is not None else \
+            self.names[msg_id % len(self.names)]
+        self.pending[msg_id] = {
+            "msg_id": msg_id, "client": client, "ops": ops,
+            "start_us": int(time.monotonic() * 1e6), "reply": None}
+        self.procs[dest].send({"src": client, "dest": dest,
+                               "body": {"type": "txn", "msg_id": msg_id,
+                                        "txn": ops}})
+        return msg_id
+
+    # ------------------------------------------------------------ workload --
+    def run_workload(self, n_ops: int = 40, n_keys: int = 8,
+                     pipeline: int = 4, deadline_s: float = 120.0) -> dict:
+        """Randomized append/read mix; returns counters. Appended values are
+        globally unique so the verifier can track per-key sequences."""
+        import random
+        rng = random.Random(self.seed)
+        next_value = [0]
+        submitted = [0]
+
+        def submit_one():
+            client = f"c{1 + rng.randrange(4)}"
+            k = rng.randrange(n_keys)
+            ops = [["r", k, None]]
+            if rng.random() < 0.7:
+                next_value[0] += 1
+                ops.append(["append", k, next_value[0]])
+            if rng.random() < 0.3:
+                k2 = rng.randrange(n_keys)
+                if not any(o == "append" and ok == k2 for o, ok, _ in ops):
+                    next_value[0] += 1
+                    ops.append(["append", k2, next_value[0]])
+            self.submit_txn(client, ops)
+            submitted[0] += 1
+
+        for _ in range(min(pipeline, n_ops)):
+            submit_one()
+        end = time.monotonic() + deadline_s
+        while len(self.results) < n_ops and time.monotonic() < end:
+            self.pump()
+            while submitted[0] < n_ops \
+                    and submitted[0] - len(self.results) < pipeline:
+                submit_one()
+        ok = sum(1 for r in self.results
+                 if r["reply"] and r["reply"].get("type") == "txn_ok")
+        return {"submitted": submitted[0], "completed": len(self.results),
+                "acked": ok}
+
+    # -------------------------------------------------------------- verify --
+    def final_histories(self, n_keys: int) -> Dict[int, tuple]:
+        """Read every key through an ordinary linearizable read txn."""
+        ops = [["r", k, None] for k in range(n_keys)]
+        msg_id = self.submit_txn("c9", ops, to=self.names[0])
+        assert self.pump_until(
+            lambda: any(r["msg_id"] == msg_id for r in self.results), 60.0), \
+            "final read timed out"
+        rec = next(r for r in self.results if r["msg_id"] == msg_id)
+        assert rec["reply"]["type"] == "txn_ok", rec["reply"]
+        self.results.remove(rec)
+        return {key_token(k): tuple(v)
+                for _, k, v in rec["reply"]["txn"]}
+
+    def check_strict_serializability(self, n_keys: int) -> int:
+        final = self.final_histories(n_keys)
+        verifier = StrictSerializabilityVerifier()
+        checked = 0
+        for rec in self.results:
+            reply = rec["reply"]
+            if not reply or reply.get("type") != "txn_ok":
+                continue
+            reads = {}
+            appends = {}
+            for op, k, v in reply["txn"]:
+                if op == "r":
+                    reads[key_token(k)] = tuple(v)
+                else:
+                    appends[key_token(k)] = v
+            verifier.observe(Observation(
+                f"txn{rec['msg_id']}", reads, appends,
+                rec["start_us"], rec["end_us"]))
+            checked += 1
+        verifier.verify(final)
+        return checked
+
+    def close(self) -> None:
+        for hp in self.procs.values():
+            hp.close()
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser(description="black-box maelstrom run")
+    ap.add_argument("-n", "--nodes", type=int, default=3)
+    ap.add_argument("-o", "--ops", type=int, default=40)
+    ap.add_argument("-k", "--keys", type=int, default=8)
+    ap.add_argument("-s", "--seed", type=int, default=0)
+    ns = ap.parse_args()
+    runner = MaelstromRunner(ns.nodes, ns.seed)
+    try:
+        t0 = time.monotonic()
+        runner.init_all()
+        stats = runner.run_workload(ns.ops, ns.keys)
+        checked = runner.check_strict_serializability(ns.keys)
+        dt = time.monotonic() - t0
+        print(json.dumps({**stats, "verified_txns": checked,
+                          "wall_s": round(dt, 2),
+                          "txns_per_sec": round(stats["acked"] / dt, 1),
+                          "ok": True}))
+    finally:
+        runner.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -197,6 +197,12 @@ class ListResult(Result):
 
 
 class ListQuery(Query):
+    def __eq__(self, other):
+        return isinstance(other, ListQuery)  # stateless
+
+    def __hash__(self):
+        return hash(ListQuery)
+
     def compute(self, txn_id: TxnId, execute_at: Timestamp,
                 data: Optional[Data], read: Optional[Read],
                 update: Optional[Update]) -> Result:

@@ -21,3 +21,8 @@ os.environ.setdefault("ACCORD_PARANOIA", "PARANOID")
 import jax
 
 jax.config.update("jax_platforms", _platform)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process black-box runs and other slow tests")

@@ -1,0 +1,170 @@
+"""External host tier: wire codec round-trips + black-box subprocess runs.
+
+Reference model: accord-maelstrom (Json.java codec adapters, Main.java stdin
+host, the in-JVM Cluster runner). The black-box test spawns REAL OS
+processes speaking the Maelstrom JSON protocol and checks the client-visible
+history with the burn test's strict-serializability verifier.
+"""
+
+import json
+
+import pytest
+
+from accord_tpu.host.wire import decode_message, encode_message
+from accord_tpu.impl.list_store import (ListData, ListQuery, ListRead,
+                                        ListResult, ListUpdate, ListWrite)
+from accord_tpu.local.status import Durability, SaveStatus
+from accord_tpu.primitives.deps import Deps, KeyDeps, RangeDeps
+from accord_tpu.primitives.keys import (Key, Keys, Range, Ranges, Route,
+                                        RoutingKeys)
+from accord_tpu.primitives.latest_deps import LatestDeps
+from accord_tpu.primitives.timestamp import (Ballot, Domain, Timestamp,
+                                             TxnId, TxnKind)
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.primitives.writes import Writes
+
+
+def tid(hlc, node=1, kind=TxnKind.WRITE):
+    return TxnId.create(1, hlc, kind, Domain.KEY, node)
+
+
+def roundtrip(msg):
+    blob = json.dumps(encode_message(msg))
+    return decode_message(json.loads(blob))
+
+
+def sample_txn():
+    return Txn(TxnKind.WRITE, Keys.of(1, 2),
+               read=ListRead(Keys.of(1)), query=ListQuery(),
+               update=ListUpdate({Key(2): 9}))
+
+
+def sample_route():
+    keys = RoutingKeys.of(1, 2)
+    return Route(keys[0], keys=keys)
+
+
+def sample_deps():
+    return Deps(KeyDeps.of({Key(1): {tid(5), tid(6, 2)}}),
+                RangeDeps.of({Range(0, 10): [tid(7, kind=TxnKind
+                                                 .EXCLUSIVE_SYNC_POINT)]}))
+
+
+class TestWireRoundTrips:
+    def test_primitives(self):
+        for obj in (tid(9), Ballot(1, 5, 0, 2), Timestamp(1, 2, 3, 4),
+                    Keys.of(1, 2, 3), Ranges.of((0, 5), (9, 12)),
+                    sample_route(), sample_deps(), sample_txn()):
+            back = roundtrip(obj)
+            assert back == obj, (obj, back)
+
+    def test_every_wire_verb_roundtrips(self):
+        """One instance of every remote message type in the registry."""
+        from accord_tpu.messages import base as mb
+        from accord_tpu.messages.accept import (Accept, AcceptInvalidate,
+                                                AcceptOk)
+        from accord_tpu.messages.apply_msg import Apply, ApplyKind, ApplyReply
+        from accord_tpu.messages.checkstatus import CheckStatus, IncludeInfo
+        from accord_tpu.messages.commit import (Commit, CommitInvalidate,
+                                                CommitKind)
+        from accord_tpu.messages.durability import (InformDurable,
+                                                    InformOfTxnId,
+                                                    QueryDurableBefore,
+                                                    QueryDurableBeforeOk,
+                                                    SetGloballyDurable,
+                                                    SetShardDurable)
+        from accord_tpu.messages.ephemeral import (GetEphemeralReadDeps,
+                                                   GetEphemeralReadDepsOk)
+        from accord_tpu.messages.epoch import EpochSyncComplete, FetchSnapshot
+        from accord_tpu.messages.getdeps import GetDeps, GetDepsOk
+        from accord_tpu.messages.invalidate_msg import (BeginInvalidation,
+                                                        InvalidateReply)
+        from accord_tpu.messages.maxconflict import (GetMaxConflict,
+                                                     GetMaxConflictOk)
+        from accord_tpu.messages.preaccept import (PreAccept, PreAcceptNack,
+                                                   PreAcceptOk)
+        from accord_tpu.messages.read import ReadNack, ReadOk, ReadTxnData
+        from accord_tpu.messages.recover import (BeginRecovery, RecoverNack,
+                                                 RecoverOk)
+        from accord_tpu.messages.wait import WaitOnCommit
+        from accord_tpu.local.watermarks import DurableBefore
+
+        t = tid(9)
+        route = sample_route()
+        scope = route.slice(route.covering())
+        txn = sample_txn()
+        part = txn.slice(scope.covering(), include_query=True)
+        deps = sample_deps()
+        ts = t.as_timestamp()
+        ballot = Ballot(1, 44, 0, 3)
+        writes = Writes(t, ts, Keys.of(2), ListWrite({Key(2): 9}))
+        result = ListResult(t, ts, {Key(1): (4,)}, {Key(2): 9})
+        latest = LatestDeps.create(Ranges.of((0, 100)), SaveStatus
+                                   .ACCEPTED.known().deps, ballot, deps, deps)
+
+        msgs = [
+            PreAccept(t, part, scope, 1, full_route=route),
+            PreAcceptOk(t, ts, deps),
+            PreAcceptNack(),
+            Accept(t, ballot, scope, Keys.of(1, 2), ts, deps,
+                   full_route=route),
+            AcceptOk(t, deps),
+            AcceptInvalidate(t, ballot, scope),
+            Commit(CommitKind.STABLE_FAST_PATH, t, scope, part, ts, deps,
+                   full_route=route),
+            CommitInvalidate(t, scope),
+            Apply(ApplyKind.MINIMAL, t, scope, ts, deps, writes, result),
+            ApplyReply(ApplyReply.APPLIED),
+            ReadTxnData(t, scope, Keys.of(1), 1),
+            ReadOk(ListData({Key(1): (4,)})),
+            ReadNack(ReadNack.NOT_COMMITTED),
+            BeginRecovery(t, scope, ballot, full_route=route),
+            RecoverNack(ballot),
+            BeginInvalidation(t, scope, ballot),
+            InvalidateReply(None, ballot, SaveStatus.ACCEPTED, False, route),
+            GetDeps(t, scope, Keys.of(1), ts),
+            GetDepsOk(deps),
+            GetEphemeralReadDeps(t, scope, Keys.of(1)),
+            GetEphemeralReadDepsOk(deps, 1),
+            GetMaxConflict(scope, Keys.of(1), 1),
+            GetMaxConflictOk(ts, 1),
+            WaitOnCommit(t, scope),
+            CheckStatus(t, scope, IncludeInfo.ALL),
+            InformOfTxnId(t, scope),
+            InformDurable(t, scope, Durability.MAJORITY),
+            SetShardDurable(t, scope, Ranges.of((0, 5)), universal=False),
+            SetGloballyDurable(t, scope, Ranges.of((0, 5)), t, t),
+            QueryDurableBefore(t, scope, Ranges.of((0, 5))),
+            QueryDurableBeforeOk(t, t),
+            EpochSyncComplete(1),
+            FetchSnapshot(t, Ranges.of((0, 5))),
+            mb.SimpleReply(mb.SimpleReply.OK),
+            mb.FailureReply(RuntimeError("boom")),
+        ]
+        for msg in msgs:
+            back = roundtrip(msg)
+            assert type(back) is type(msg), msg
+            if hasattr(msg, "txn_id"):
+                assert back.txn_id == msg.txn_id
+        # latest_deps-bearing RecoverOk
+        ok = RecoverOk(t, SaveStatus.ACCEPTED, ballot, ts, latest, part,
+                       None, None, False, Deps.NONE, Deps.NONE)
+        back = roundtrip(ok)
+        assert back.latest_deps == ok.latest_deps
+        assert back.latest_deps.merge_proposal() == \
+            ok.latest_deps.merge_proposal()
+
+
+@pytest.mark.slow
+class TestBlackBoxCluster:
+    def test_three_process_cluster_strict_serializable(self):
+        from accord_tpu.host.runner import MaelstromRunner
+        runner = MaelstromRunner(n_nodes=3, seed=7)
+        try:
+            runner.init_all()
+            stats = runner.run_workload(n_ops=25, n_keys=6)
+            assert stats["acked"] >= 20, stats
+            checked = runner.check_strict_serializability(n_keys=6)
+            assert checked == stats["acked"]
+        finally:
+            runner.close()
