@@ -71,6 +71,34 @@ class MessageSink(abc.ABC):
         ...
 
 
+class CallbackSink(MessageSink):
+    """msg-id/callback bookkeeping shared by concrete sinks (sim NodeSink,
+    host MaelstromSink). Entries are released BOTH on reply delivery and on
+    RPC timeout — registration installs an unregister hook the node's safe
+    callback fires when its timer expires, so a long-lived host under
+    partitions does not pin dead coordination state forever."""
+
+    def __init__(self):
+        self._seq = 0
+        self._callbacks: dict = {}
+
+    def _register(self, callback) -> int:
+        self._seq += 1
+        msg_id = self._seq
+        self._callbacks[msg_id] = callback
+        try:
+            callback.sink_unregister = (
+                lambda: self._callbacks.pop(msg_id, None))
+        except AttributeError:
+            pass  # slotted callbacks just stay until delivery
+        return msg_id
+
+    def deliver_reply(self, msg_id: int, from_id: int, reply) -> None:
+        callback = self._callbacks.pop(msg_id, None)
+        if callback is not None:
+            callback.deliver(reply)
+
+
 class EpochReady:
     """Four-phase epoch readiness (reference api/ConfigurationService.EpochReady):
     metadata -> coordination -> data -> reads, each an AsyncResult."""

@@ -22,7 +22,7 @@ import time
 import zlib
 from typing import Dict, Optional
 
-from accord_tpu.api.spi import Agent, MessageSink
+from accord_tpu.api.spi import Agent, CallbackSink
 from accord_tpu.host.rt import RealTimeScheduler
 from accord_tpu.host.wire import decode_message, encode_message
 from accord_tpu.impl.list_store import (ListQuery, ListRead, ListResult,
@@ -65,13 +65,12 @@ class HostAgent(Agent):
         return Txn(kind, keys_or_ranges)
 
 
-class MaelstromSink(MessageSink):
+class MaelstromSink(CallbackSink):
     """MessageSink writing Maelstrom envelopes (reference Wrapper/Packet)."""
 
     def __init__(self, host: "MaelstromHost"):
+        super().__init__()
         self.host = host
-        self._seq = 0
-        self._callbacks: Dict[int, object] = {}
 
     def send(self, to: int, request: Request) -> None:
         self.host.emit_node(to, {"type": "accord",
@@ -79,9 +78,8 @@ class MaelstromSink(MessageSink):
 
     def send_with_callback(self, to: int, request: Request, callback,
                            executor=None) -> None:
-        self._seq += 1
-        self._callbacks[self._seq] = callback
-        self.host.emit_node(to, {"type": "accord", "msg_id": self._seq,
+        msg_id = self._register(callback)
+        self.host.emit_node(to, {"type": "accord", "msg_id": msg_id,
                                  "payload": encode_message(request)})
 
     def reply(self, to: int, reply_context, reply: Reply) -> None:
@@ -90,11 +88,6 @@ class MaelstromSink(MessageSink):
         self.host.emit_node(to, {"type": "accord",
                                  "in_reply_to": reply_context,
                                  "payload": encode_message(reply)})
-
-    def deliver_reply(self, msg_id: int, from_id: int, reply) -> None:
-        callback = self._callbacks.pop(msg_id, None)
-        if callback is not None:
-            callback.deliver(reply)
 
 
 class MaelstromHost:
@@ -208,11 +201,17 @@ class MaelstromHost:
             out = []
             values = (result.read_values
                       if isinstance(result, ListResult) else {})
+            applied: Dict[Key, list] = {}  # own appends, in micro-op order
             for op, k, v in ops:
+                kk = Key(key_token(k))
                 if op == "r":
-                    got = values.get(Key(key_token(k)))
-                    out.append([op, k, list(got) if got is not None else []])
+                    # txn-list-append semantics: a read observes the
+                    # pre-state PLUS this txn's earlier appends to the key
+                    pre = values.get(kk)
+                    got = list(pre) if pre is not None else []
+                    out.append([op, k, got + applied.get(kk, [])])
                 else:
+                    applied.setdefault(kk, []).append(v)
                     out.append([op, k, v])
             self._emit(client, {"type": "txn_ok", "in_reply_to": msg_id,
                                 "txn": out})

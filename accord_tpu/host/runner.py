@@ -65,7 +65,11 @@ class HostProcess:
         except Exception:  # noqa: BLE001
             pass
         self.proc.terminate()
-        self.proc.wait(timeout=10)
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()  # a wedged child must not abort teardown
+            self.proc.wait(timeout=10)
 
 
 class MaelstromRunner:
@@ -189,6 +193,11 @@ class MaelstromRunner:
     # -------------------------------------------------------------- verify --
     def final_histories(self, n_keys: int) -> Dict[int, tuple]:
         """Read every key through an ordinary linearizable read txn."""
+        # drain in-flight txns first: a straggler acked after the final-read
+        # snapshot would be verified against a state that predates it
+        self.pump_until(lambda: not self.pending, 30.0)
+        for msg_id in list(self.pending):
+            del self.pending[msg_id]  # never acked; late replies are ignored
         ops = [["r", k, None] for k in range(n_keys)]
         msg_id = self.submit_txn("c9", ops, to=self.names[0])
         assert self.pump_until(

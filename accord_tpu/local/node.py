@@ -44,6 +44,9 @@ class _SafeCallback:
     def _on_timeout(self) -> None:
         if not self.done:
             self.done = True
+            unregister = getattr(self, "sink_unregister", None)
+            if unregister is not None:
+                unregister()  # release the sink's msg-id entry (CallbackSink)
             try:
                 self.callback.on_failure(self.to, Timeout())
             except BaseException as e:  # noqa: BLE001

@@ -11,7 +11,7 @@ from __future__ import annotations
 import enum
 from typing import Callable, Dict, Optional, Tuple
 
-from accord_tpu.api.spi import MessageSink
+from accord_tpu.api.spi import CallbackSink, MessageSink
 from accord_tpu.messages.base import FailureReply, Reply, Request
 from accord_tpu.sim.queue import PendingQueue
 from accord_tpu.utils.random_source import RandomSource
@@ -182,23 +182,20 @@ class PartitionNemesis:
                        self._tick)
 
 
-class NodeSink(MessageSink):
+class NodeSink(CallbackSink):
     """MessageSink bound to one simulated node."""
 
     def __init__(self, node_id: int, network: SimNetwork):
+        super().__init__()
         self.node_id = node_id
         self.network = network
-        self._seq = 0
-        self._callbacks: Dict[int, object] = {}  # msg_id -> _SafeCallback
 
     def send(self, to: int, request: Request) -> None:
         self.network.deliver_request(self.node_id, to, request, None)
 
     def send_with_callback(self, to: int, request: Request, callback,
                            executor=None) -> None:
-        self._seq += 1
-        msg_id = self._seq
-        self._callbacks[msg_id] = callback
+        msg_id = self._register(callback)
         self.network.deliver_request(self.node_id, to, request,
                                      (self.node_id, msg_id))
 
@@ -207,8 +204,3 @@ class NodeSink(MessageSink):
             return
         origin, msg_id = reply_context
         self.network.deliver_reply(self.node_id, origin, msg_id, reply)
-
-    def deliver_reply(self, msg_id: int, from_id: int, reply: Reply) -> None:
-        callback = self._callbacks.pop(msg_id, None)
-        if callback is not None:
-            callback.deliver(reply)

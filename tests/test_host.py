@@ -162,6 +162,18 @@ class TestBlackBoxCluster:
         runner = MaelstromRunner(n_nodes=3, seed=7)
         try:
             runner.init_all()
+            # txn-list-append intra-txn atomicity: a read AFTER an append in
+            # the same txn observes the append (Elle 'internal' check)
+            msg_id = runner.submit_txn(
+                "c8", [["append", 42, 7], ["r", 42, None]])
+            assert runner.pump_until(
+                lambda: any(r["msg_id"] == msg_id for r in runner.results),
+                30.0)
+            rec = next(r for r in runner.results if r["msg_id"] == msg_id)
+            assert rec["reply"]["type"] == "txn_ok", rec["reply"]
+            assert rec["reply"]["txn"][1] == ["r", 42, [7]]
+            runner.results.remove(rec)
+
             stats = runner.run_workload(n_ops=25, n_keys=6)
             assert stats["acked"] >= 20, stats
             checked = runner.check_strict_serializability(n_keys=6)
