@@ -265,6 +265,11 @@ class LocalConfig:
     progress_log_schedule_delay_s: float = 0.2
     epoch_await_timeout_s: float = 30.0
     command_store_shard_count: int = 8
+    # RPC reply timeout = agent.pre_accept_timeout() * this
+    rpc_timeout_multiplier: float = 10.0
+    bootstrap_retry_delay_s: float = 1.0
+    durability_shard_cycle_s: float = 30.0
+    durability_global_cycle_every: int = 4
 
     @classmethod
     def default(cls) -> "LocalConfig":

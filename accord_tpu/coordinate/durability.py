@@ -196,11 +196,14 @@ class CoordinateDurabilityScheduling:
     from the node's index in the topology so coordinators rarely collide
     (collisions are harmless — sync points are just transactions)."""
 
-    def __init__(self, node, shard_cycle_s: float = 30.0,
-                 global_cycle_every: int = 4):
+    def __init__(self, node, shard_cycle_s: float = None,
+                 global_cycle_every: int = None):
         self.node = node
-        self.shard_cycle_s = shard_cycle_s
-        self.global_cycle_every = global_cycle_every
+        self.shard_cycle_s = (shard_cycle_s if shard_cycle_s is not None
+                              else node.config.durability_shard_cycle_s)
+        self.global_cycle_every = (
+            global_cycle_every if global_cycle_every is not None
+            else node.config.durability_global_cycle_every)
         self.counter = 0
         self._task = None
 

@@ -127,7 +127,8 @@ class CoordinateEphemeralRead:
             self.route.participants())
         self.read_topologies = Topologies([selected])
         self.read_tracker = ReadTracker(self.read_topologies)
-        prefer = [self.node.id] + sorted(selected.nodes())
+        prefer = [self.node.id] + self.node.topology.sorter.sort(
+            selected.nodes(), self.read_topologies)
         for to in self.read_tracker.initial_contacts(prefer):
             self._send_read(to)
 

@@ -131,7 +131,8 @@ class ExecutePath(Callback):
                      else Keys(()))
         self.read_tracker = (ReadTracker(Topologies([execute_topology]))
                              if read_keys else None)
-        prefer = [self.node.id] + sorted(execute_topology.nodes())
+        prefer = [self.node.id] + self.node.topology.sorter.sort(
+            execute_topology.nodes(), [execute_topology])
         self.read_nodes = (self.read_tracker.initial_contacts(prefer)
                            if self.read_tracker else [])
         maximal = self.commit_kind == CommitKind.STABLE_MAXIMAL

@@ -219,11 +219,18 @@ class MaelstromRunner:
                 continue
             reads = {}
             appends = {}
+            applied_so_far: Dict[int, int] = {}
             for op, k, v in reply["txn"]:
+                token = key_token(k)
                 if op == "r":
-                    reads[key_token(k)] = tuple(v)
+                    # the wire reply includes this txn's own earlier appends
+                    # (Maelstrom txn-list-append semantics); the verifier's
+                    # Observation wants the PRE-state read, so strip them
+                    own = applied_so_far.get(token, 0)
+                    reads[token] = tuple(v[:len(v) - own] if own else v)
                 else:
-                    appends[key_token(k)] = v
+                    appends[token] = v
+                    applied_so_far[token] = applied_so_far.get(token, 0) + 1
             verifier.observe(Observation(
                 f"txn{rec['msg_id']}", reads, appends,
                 rec["start_us"], rec["end_us"]))

@@ -26,11 +26,10 @@ class Bootstrap(Callback):
     itself (fresh fence) on failure — the reference defers the retry policy
     to Agent.onFailedBootstrap."""
 
-    RETRY_DELAY_S = 1.0
-
     def __init__(self, node, ranges: Ranges, epoch: int,
                  result: Optional[AsyncResult] = None):
         self.node = node
+        self.RETRY_DELAY_S = node.config.bootstrap_retry_delay_s
         self.ranges = ranges
         self.epoch = epoch
         self.result = result if result is not None else AsyncResult()

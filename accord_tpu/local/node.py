@@ -305,7 +305,8 @@ class Node:
             if callback is not None:
                 safe = _SafeCallback(self, to, callback)
                 safe.arm_timeout(timeout_s if timeout_s is not None
-                                 else self.agent.pre_accept_timeout() * 10)
+                                 else self.agent.pre_accept_timeout()
+                                 * self.config.rpc_timeout_multiplier)
                 self.sink.send_with_callback(to, request, safe)
             else:
                 self.sink.send(to, request)

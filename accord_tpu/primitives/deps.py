@@ -18,7 +18,7 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence,
 from accord_tpu.primitives.keys import Key, Keys, Range, Ranges, RoutingKey, RoutingKeys
 from accord_tpu.primitives.timestamp import TxnId
 from accord_tpu.utils import invariants
-from accord_tpu.utils.sorted_arrays import find_ceil
+from accord_tpu.utils.sorted_arrays import find_ceil, linear_union
 
 
 def _build_csr(sorted_lhs: Sequence, lhs_to_sets: Dict, sorted_rhs: Sequence
@@ -144,48 +144,138 @@ class KeyDeps:
     def participating_keys(self) -> Keys:
         return self.keys
 
-    # -- algebra --
-    def _as_map(self) -> Dict[Key, Set[TxnId]]:
-        out: Dict[Key, Set[TxnId]] = {}
-        for ki, k in enumerate(self.keys):
-            s, e = self._span(ki)
-            out[k] = {self.txn_ids[self.keys_to_txn_ids[j]] for j in range(s, e)}
-        return out
+    # -- algebra (linear CSR walks; reference RelationMultiMap.LinearMerger
+    # merges the flat arrays the same way, no intermediate maps) --
+    def _span_indices(self, ki: int) -> List[int]:
+        s, e = self._span(ki)
+        return [self.keys_to_txn_ids[j] for j in range(s, e)]
+
+    def _remap_into(self, merged_ids: Sequence[TxnId]) -> List[int]:
+        """positions of our (sorted) txn_ids within merged (sorted) ids."""
+        remap: List[int] = []
+        j = 0
+        for t in self.txn_ids:
+            while merged_ids[j] != t:
+                j += 1
+            remap.append(j)
+        return remap
+
+    @staticmethod
+    def _from_spans(keys: List[Key], spans: List[List[int]],
+                    id_pool: Sequence[TxnId]) -> "KeyDeps":
+        """Assemble a CSR from per-key ascending id-index lists, compacting
+        the id pool to the indices actually referenced."""
+        if not keys:
+            return KeyDeps.NONE
+        used = sorted({i for span in spans for i in span})
+        compact = {old: new for new, old in enumerate(used)}
+        ids = tuple(id_pool[i] for i in used)
+        nk = len(keys)
+        ends: List[int] = []
+        payload: List[int] = []
+        off = nk
+        for span in spans:
+            payload.extend(compact[i] for i in span)
+            off += len(span)
+            ends.append(off)
+        return KeyDeps(Keys(keys, _presorted=True), ids,
+                       tuple(ends + payload))
 
     def with_(self, other: "KeyDeps") -> "KeyDeps":
         if self.is_empty:
             return other
         if other.is_empty:
             return self
-        m = self._as_map()
-        for k, ids in other._as_map().items():
-            m.setdefault(k, set()).update(ids)
-        return KeyDeps.of(m)
+        merged_ids = linear_union(self.txn_ids, other.txn_ids)
+        remap_a = self._remap_into(merged_ids)
+        remap_b = other._remap_into(merged_ids)
+        keys_a, keys_b = list(self.keys), list(other.keys)
+        out_keys: List[Key] = []
+        out_spans: List[List[int]] = []
+        ia = ib = 0
+        while ia < len(keys_a) or ib < len(keys_b):
+            if ib >= len(keys_b) or (ia < len(keys_a)
+                                     and keys_a[ia] < keys_b[ib]):
+                out_keys.append(keys_a[ia])
+                out_spans.append([remap_a[i] for i in self._span_indices(ia)])
+                ia += 1
+            elif ia >= len(keys_a) or keys_b[ib] < keys_a[ia]:
+                out_keys.append(keys_b[ib])
+                out_spans.append([remap_b[i]
+                                  for i in other._span_indices(ib)])
+                ib += 1
+            else:
+                sa = [remap_a[i] for i in self._span_indices(ia)]
+                sb = [remap_b[i] for i in other._span_indices(ib)]
+                out_keys.append(keys_a[ia])
+                out_spans.append(list(linear_union(sa, sb)))
+                ia += 1
+                ib += 1
+        return KeyDeps._from_spans(out_keys, out_spans, merged_ids)
 
     def without(self, predicate: Callable[[TxnId], bool]) -> "KeyDeps":
-        m = {k: {t for t in ids if not predicate(t)}
-             for k, ids in self._as_map().items()}
-        return KeyDeps.of({k: ids for k, ids in m.items() if ids})
+        keep = [not predicate(t) for t in self.txn_ids]
+        if all(keep):
+            return self
+        out_keys: List[Key] = []
+        out_spans: List[List[int]] = []
+        for ki, k in enumerate(self.keys):
+            span = [i for i in self._span_indices(ki) if keep[i]]
+            if span:
+                out_keys.append(k)
+                out_spans.append(span)
+        return KeyDeps._from_spans(out_keys, out_spans, self.txn_ids)
 
     def without_ids(self, remove: Set[TxnId]) -> "KeyDeps":
         return self.without(lambda t: t in remove)
 
     def slice(self, ranges: Ranges) -> "KeyDeps":
-        m = {k: ids for k, ids in self._as_map().items() if ranges.contains(k)}
-        return KeyDeps.of(m)
+        out_keys: List[Key] = []
+        out_spans: List[List[int]] = []
+        for ki, k in enumerate(self.keys):
+            if ranges.contains(k):
+                out_keys.append(k)
+                out_spans.append(self._span_indices(ki))
+        if len(out_keys) == len(self.keys):
+            return self
+        return KeyDeps._from_spans(out_keys, out_spans, self.txn_ids)
 
     @staticmethod
     def merge(deps: Sequence["KeyDeps"]) -> "KeyDeps":
+        """Single-pass k-way merge over the flat CSRs (the reference's
+        LinearMerger): one id-pool union, one remap per input, one walk over
+        the merged key space — no per-pair CSR rebuilds."""
         live = [d for d in deps if d is not None and not d.is_empty]
         if not live:
             return KeyDeps.NONE
         if len(live) == 1:
             return live[0]
-        m = live[0]._as_map()
+        merged_ids: Sequence[TxnId] = live[0].txn_ids
         for d in live[1:]:
-            for k, ids in d._as_map().items():
-                m.setdefault(k, set()).update(ids)
-        return KeyDeps.of(m)
+            merged_ids = linear_union(merged_ids, d.txn_ids)
+        remaps = [d._remap_into(merged_ids) for d in live]
+        idxs = [0] * len(live)
+        out_keys: List[Key] = []
+        out_spans: List[List[int]] = []
+        while True:
+            cur = None
+            for src, d in enumerate(live):
+                if idxs[src] < len(d.keys):
+                    k = d.keys[idxs[src]]
+                    if cur is None or k < cur:
+                        cur = k
+            if cur is None:
+                break
+            span: List[int] = []
+            for src, d in enumerate(live):
+                i = idxs[src]
+                if i < len(d.keys) and d.keys[i] == cur:
+                    s = [remaps[src][j] for j in d._span_indices(i)]
+                    span = list(linear_union(span, s)) if span else s
+                    idxs[src] += 1
+            out_keys.append(cur)
+            out_spans.append(span)
+        return KeyDeps._from_spans(out_keys, out_spans, merged_ids)
 
     def __eq__(self, other):
         return (isinstance(other, KeyDeps) and self.keys == other.keys

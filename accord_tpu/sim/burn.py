@@ -259,6 +259,8 @@ def main(argv=None) -> int:
                              "the scalar oracle inline")
     parser.add_argument("--flush-window-us", type=int, default=200,
                         help="device-store flush window (virtual us)")
+    parser.add_argument("--message-stats", action="store_true",
+                        help="print per-message-type delivery/drop counters")
     args = parser.parse_args(argv)
     store_factory = None
     if args.device_store:
@@ -294,6 +296,15 @@ def main(argv=None) -> int:
         print(f"seed={seed} ops={args.ops} {stats} "
               f"virtual_time={run.cluster.now_s:.1f}s "
               f"events={run.cluster.queue.processed} OK{extra}")
+        if args.message_stats:
+            # per-verb delivery/drop counters (reference burn reports
+            # messageStatsMap per message type, BurnTest.java:510+)
+            net = run.cluster.network.stats
+            verbs = sorted({k.split(".", 1)[1] for k in net})
+            for verb in verbs:
+                d = net.get(f"deliver.{verb}", 0)
+                x = net.get(f"drop.{verb}", 0)
+                print(f"  {verb:<28} delivered={d:<7} dropped={x}")
         if stats.acks == 0:
             print("PATHOLOGICAL: no transaction succeeded", file=sys.stderr)
             return 1

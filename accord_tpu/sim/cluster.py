@@ -124,8 +124,8 @@ class SimCluster:
         for node in self.nodes.values():
             node.on_topology_update(topology)
 
-    def start_durability_scheduling(self, shard_cycle_s: float = 30.0,
-                                    global_cycle_every: int = 4) -> None:
+    def start_durability_scheduling(self, shard_cycle_s: float = None,
+                                    global_cycle_every: int = None) -> None:
         """Run the reference's rotating durability rounds on every node
         (CoordinateDurabilityScheduling.java; burn Cluster.java:333-349)."""
         from accord_tpu.coordinate.durability import \

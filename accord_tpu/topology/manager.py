@@ -44,8 +44,9 @@ class EpochState:
 
 class TopologyManager:
     def __init__(self, node_id: int, sorter=None):
+        from accord_tpu.topology.sorter import SIZE_OF_INTERSECTION
         self.node_id = node_id
-        self.sorter = sorter
+        self.sorter = sorter if sorter is not None else SIZE_OF_INTERSECTION
         self._epochs: Dict[int, EpochState] = {}
         self._min_epoch = 0
         self._max_epoch = 0

@@ -83,19 +83,26 @@ def _shrink_int_toward(lo: int):
     return shrinker
 
 
-def _shrink_list(elem: Gen):
+def _shrink_list(elem: Gen, min_size: int = 0):
     def shrinker(xs: Sequence):
         xs = list(xs)
         n = len(xs)
         if n == 0:
             return
-        yield []
+        # never leave the generator's domain: every candidate keeps min_size
+        if min_size == 0:
+            yield []
+        elif n > min_size:
+            yield xs[:min_size]
         # drop halves, then single elements
         if n > 1:
-            yield xs[:n // 2]
-            yield xs[n // 2:]
-        for i in range(n):
-            yield xs[:i] + xs[i + 1:]
+            if n // 2 >= min_size:
+                yield xs[:n // 2]
+            if n - n // 2 >= min_size:
+                yield xs[n // 2:]
+        if n - 1 >= min_size:
+            for i in range(n):
+                yield xs[:i] + xs[i + 1:]
         # shrink elements pointwise
         for i in range(n):
             for smaller in elem.shrink(xs[i]):
@@ -142,7 +149,7 @@ class Gens:
         def gen(rng):
             n = rng.next_int(min_size, max_size + 1)
             return [elem(rng) for _ in range(n)]
-        return Gen(gen, _shrink_list(elem))
+        return Gen(gen, _shrink_list(elem, min_size))
 
     @staticmethod
     def tuples(*gens: Gen) -> Gen:
