@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from accord_tpu.coordinate.errors import Exhausted, Timeout
-from accord_tpu.coordinate.tracking import (QuorumTracker, ReadTracker,
+from accord_tpu.coordinate.tracking import (QuorumTracker,
                                             RequestStatus)
 from accord_tpu.messages.base import Callback, RoundCallback, TxnRequest
 from accord_tpu.messages.ephemeral import (GetEphemeralReadDeps,
@@ -37,7 +37,7 @@ class CoordinateEphemeralRead:
         self.result = result
         self.epoch = txn_id.epoch
         self.deps_tracker: Optional[QuorumTracker] = None
-        self.read_tracker: Optional[ReadTracker] = None
+        self.reads = None  # ReadCoordinator for the read round
         self.read_topologies: Optional[Topologies] = None
         self.deps_oks: Dict[int, GetEphemeralReadDepsOk] = {}
         self.generation = 0  # bumped per round; stragglers are discarded
@@ -100,8 +100,7 @@ class CoordinateEphemeralRead:
             if reply.data is not None:
                 self.data = (reply.data if self.data is None
                              else self.data.merge(reply.data))
-            if self.read_tracker.record_read_success(from_id) \
-                    == RequestStatus.SUCCESS:
+            if self.reads.on_data(from_id):
                 self.done = True
                 self.result.try_success(
                     self.txn.result(self.txn_id, self.txn_id, self.data))
@@ -121,15 +120,20 @@ class CoordinateEphemeralRead:
 
     # ------------------------------------------------------- read round --
     def _start_read(self) -> None:
+        from accord_tpu.coordinate.read_coord import ReadCoordinator
         self.reading = True
         self.generation += 1
         selected = self.node.topology.current().for_selection(
             self.route.participants())
         self.read_topologies = Topologies([selected])
-        self.read_tracker = ReadTracker(self.read_topologies)
-        prefer = [self.node.id] + self.node.topology.sorter.sort(
-            selected.nodes(), self.read_topologies)
-        for to in self.read_tracker.initial_contacts(prefer):
+
+        def exhausted():
+            self.done = True
+            self.result.try_failure(Exhausted("ephemeral read exhausted"))
+
+        self.reads = ReadCoordinator(self.node, self.read_topologies,
+                                     self._send_read, exhausted)
+        for to in self.reads.initial_contacts():
             self._send_read(to)
 
     def _send_read(self, to: int) -> None:
@@ -149,10 +153,4 @@ class CoordinateEphemeralRead:
             callback=RoundCallback(self, ("read", self.generation)))
 
     def _retry_read(self, from_id: int) -> None:
-        status, retry = self.read_tracker.record_read_failure(from_id)
-        if status == RequestStatus.FAILED:
-            self.done = True
-            self.result.try_failure(Exhausted("ephemeral read exhausted"))
-            return
-        for to in retry:
-            self._send_read(to)
+        self.reads.on_slow_or_failed(from_id)

@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Timeout
-from accord_tpu.coordinate.tracking import QuorumTracker, ReadTracker, RequestStatus
+from accord_tpu.coordinate.tracking import QuorumTracker, RequestStatus
 from accord_tpu.messages.accept import Accept, AcceptNack, AcceptOk
 from accord_tpu.messages.apply_msg import Apply, ApplyKind, ApplyReply
 from accord_tpu.messages.base import Callback, RoundCallback, TxnRequest
@@ -110,7 +110,7 @@ class ExecutePath(Callback):
         self.applied_result = applied_result
         self.applied_tracker: Optional[QuorumTracker] = None
         self.stable_tracker: Optional[QuorumTracker] = None
-        self.read_tracker: Optional[ReadTracker] = None
+        self.reads = None  # ReadCoordinator when the txn has a read set
         self.read_nodes: List[int] = []
         self.read_data = None
         self.executed = False
@@ -121,6 +121,7 @@ class ExecutePath(Callback):
         self.node.with_epoch(self.execute_at.epoch, self._start)
 
     def _start(self) -> None:
+        from accord_tpu.coordinate.read_coord import ReadCoordinator
         from accord_tpu.topology.topologies import Topologies
         execute_epoch = self.execute_at.epoch
         topologies = self.node.topology.with_unsynced_epochs(
@@ -129,12 +130,12 @@ class ExecutePath(Callback):
         self.stable_tracker = QuorumTracker(topologies)
         read_keys = (self.txn.read.keys() if self.txn.read is not None
                      else Keys(()))
-        self.read_tracker = (ReadTracker(Topologies([execute_topology]))
-                             if read_keys else None)
-        prefer = [self.node.id] + self.node.topology.sorter.sort(
-            execute_topology.nodes(), [execute_topology])
-        self.read_nodes = (self.read_tracker.initial_contacts(prefer)
-                           if self.read_tracker else [])
+        self.reads = (ReadCoordinator(
+            self.node, Topologies([execute_topology]), self._send_retry_read,
+            lambda: self._fail(Exhausted("read candidates exhausted")))
+            if read_keys else None)
+        self.read_nodes = (self.reads.initial_contacts()
+                           if self.reads else [])
         maximal = self.commit_kind == CommitKind.STABLE_MAXIMAL
         for to in topologies.nodes():
             scope = TxnRequest.compute_scope(to, topologies, self.route)
@@ -187,8 +188,8 @@ class ExecutePath(Callback):
             if reply.data is not None:
                 self.read_data = (reply.data if self.read_data is None
                                   else self.read_data.merge(reply.data))
-            if self.read_tracker is not None:
-                self.read_tracker.record_read_success(from_id)
+            if self.reads is not None:
+                self.reads.on_data(from_id)
         self.stable_tracker.record_success(from_id)
         self._maybe_finish()
 
@@ -199,33 +200,33 @@ class ExecutePath(Callback):
             self._fail(failure if isinstance(failure, Timeout)
                        else Exhausted(repr(failure)))
             return
-        if from_id in self.read_nodes:
+        if self.reads is not None and from_id in self.reads.contacted:
             self._retry_read(from_id)
 
     def _retry_read(self, from_id: int) -> None:
-        if self.read_tracker is None:
-            return
-        status, retry = self.read_tracker.record_read_failure(from_id)
-        if status == RequestStatus.FAILED:
-            self._fail(Exhausted("read candidates exhausted"))
-            return
+        if self.reads is not None:
+            self.reads.on_slow_or_failed(from_id)
+
+    def _send_retry_read(self, to: int) -> None:
         read_keys = self.txn.read.keys()
         topologies = self.node.topology.with_unsynced_epochs(
-            self.route.participants(), self.txn_id.epoch, self.execute_at.epoch)
-        for to in retry:
-            self.read_nodes.append(to)
-            scope = TxnRequest.compute_scope(to, topologies, self.route)
-            if scope is None:
-                continue
-            owned = scope.covering()
-            self.node.send(
-                to, ReadTxnData(self.txn_id, scope, read_keys.slice(owned),
-                                self.execute_at.epoch),
-                callback=self)
+            self.route.participants(), self.txn_id.epoch,
+            self.execute_at.epoch)
+        scope = TxnRequest.compute_scope(to, topologies, self.route)
+        if scope is None:
+            # tracker and scope derive from the same snapshot so this should
+            # be unreachable; treat as a failed read so the shard tries the
+            # next alternative instead of waiting forever
+            self.reads.on_slow_or_failed(to)
+            return
+        owned = scope.covering()
+        self.node.send(
+            to, ReadTxnData(self.txn_id, scope, read_keys.slice(owned),
+                            self.execute_at.epoch),
+            callback=self)
 
     def _maybe_finish(self) -> None:
-        reads_done = (self.read_tracker is None
-                      or all(t.has_data for t in self.read_tracker.trackers))
+        reads_done = self.reads is None or self.reads.has_all_data
         if reads_done and self.stable_tracker.has_reached_quorum \
                 and not self.executed:
             self.executed = True

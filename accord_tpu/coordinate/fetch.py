@@ -215,7 +215,15 @@ def maybe_recover(node, txn_id: TxnId, route: Route,
             best = route.with_(merged.route)
         undecided = merged is None \
             or merged.save_status < SaveStatus.PRE_COMMITTED
-        chase = (node.invalidate if undecided and not best.is_full
+        # durability-derived evidence (coordinate/infer.py): an undecided
+        # txn below the majority-durability bound is headed for
+        # invalidation — go straight to the ballot-backed invalidation
+        # round instead of attempting recovery first (its ballots still
+        # settle any race with a live recovery)
+        inferred_invalid = (undecided and merged is not None
+                            and merged.invalid_if_undecided)
+        chase = (node.invalidate
+                 if undecided and (inferred_invalid or not best.is_full)
                  else node.recover)
         chase(txn_id, best).add_callback(
             lambda v, f: result.try_failure(f) if f is not None

@@ -1,0 +1,36 @@
+"""Infer: durability-derived invalidation evidence on CheckStatus replies.
+
+Reference: accord/coordinate/Infer.java — replicas attach "invalid-if-not"
+conditions derived from their durability watermarks; the fetcher combines
+them with the merged (still-undecided) status to steer resolution toward
+invalidation.
+
+Our condition: the store's DurableBefore majority bound exceeds txn_id over
+an owned participant while the store itself holds no decision. Below that
+bound every transaction the durability rounds fenced has resolved
+(majority-applied or invalidated, watermarks.DurableBefore), so an
+undecided straggler there is almost certainly headed for invalidation.
+
+We deliberately stop short of the reference's no-ballot
+`inferInvalidWithQuorum` commit: our recovery keeps the right to decide a
+sub-fence transaction on the slow path with an executeAt above the fence
+(local/commands.py:179 — refusing could fabricate evidence against a
+decided-elsewhere txn), so a raced no-round invalidation would not be
+provably safe. Instead the evidence routes the progress log's escalation
+through the multi-shard Invalidate round — whose ballots settle any race
+with recovery — rather than attempting recovery first and failing.
+"""
+
+from __future__ import annotations
+
+from accord_tpu.primitives.keys import Ranges
+from accord_tpu.primitives.timestamp import TxnId
+
+
+def invalid_if_undecided(safe_store, txn_id: TxnId, participants) -> bool:
+    """Is txn_id below the majority-durability bound of some owned
+    participant span? (Infer.invalidIfNot's DurableBefore conditions)"""
+    db = safe_store.store.durable_before
+    if isinstance(participants, Ranges):
+        return db.is_any_majority_durable(txn_id, participants)
+    return any(db.is_majority_durable(txn_id, k) for k in participants)

@@ -1,0 +1,64 @@
+"""ReadCoordinator: the generic quorum-read retry machine.
+
+Reference: accord/coordinate/ReadCoordinator.java — Action.Approve /
+Action.TryAlternative: one data response per shard suffices; a failed or
+slow replica is replaced by an untried alternative from the same shard until
+every shard has answered or a shard runs out of candidates. Shared by the
+execution read (ExecutePath: reads piggyback on the Stable round, retries go
+out as plain ReadTxnData) and the ephemeral read round.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from accord_tpu.coordinate.tracking import ReadTracker, RequestStatus
+
+
+class ReadCoordinator:
+    """Owns the ReadTracker; the caller sends (initial reads may piggyback
+    on another round, so `initial_contacts` only *picks*) and feeds replies
+    back through on_data / on_slow_or_failed."""
+
+    def __init__(self, node, topologies, send_read: Callable[[int], None],
+                 on_exhausted: Callable[[], None]):
+        self.node = node
+        self.topologies = topologies
+        self.tracker = ReadTracker(topologies)
+        self._send_read = send_read
+        self._on_exhausted = on_exhausted
+        self.contacted: List[int] = []
+        self.exhausted = False
+
+    def initial_contacts(self, prefer_first: Optional[Sequence[int]] = None
+                         ) -> List[int]:
+        """One replica per shard, topology-sorter order, self first.
+        Returns a copy — `contacted` keeps growing as retries fan out."""
+        prefer = list(prefer_first or ())
+        prefer += [self.node.id] + self.node.topology.sorter.sort(
+            self.topologies.nodes(), self.topologies)
+        self.contacted = self.tracker.initial_contacts(prefer)
+        return list(self.contacted)
+
+    @property
+    def has_all_data(self) -> bool:
+        return all(t.has_data for t in self.tracker.trackers)
+
+    def on_data(self, from_id: int) -> bool:
+        """Approve: record a data response; True once every shard has one."""
+        return (self.tracker.record_read_success(from_id)
+                == RequestStatus.SUCCESS)
+
+    def on_slow_or_failed(self, from_id: int) -> None:
+        """TryAlternative: replace this replica with an untried one from each
+        shard it was covering; exhaust when some shard has no candidates."""
+        if self.exhausted:
+            return
+        status, retry = self.tracker.record_read_failure(from_id)
+        if status == RequestStatus.FAILED:
+            self.exhausted = True
+            self._on_exhausted()
+            return
+        for to in retry:
+            self.contacted.append(to)
+            self._send_read(to)

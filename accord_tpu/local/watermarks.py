@@ -235,6 +235,14 @@ class DurableBefore:
         e = self._map.get(key.token)
         return e is not None and txn_id < e.majority_before
 
+    def is_any_majority_durable(self, txn_id: TxnId, ranges: Ranges) -> bool:
+        """Does some span of `ranges` hold a majority bound above txn_id?"""
+        def fold(acc, _s, _e, v):
+            return acc or txn_id < v.majority_before
+
+        return any(self._map.fold(fold, False, start=r.start, end=r.end)
+                   for r in ranges)
+
     def is_universally_durable(self, txn_id: TxnId, key: RoutingKey) -> bool:
         e = self._map.get(key.token)
         return e is not None and txn_id < e.universal_before

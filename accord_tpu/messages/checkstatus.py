@@ -38,7 +38,8 @@ class CheckStatusOk(Reply):
                  is_coordinating: bool = False,
                  partial_txn: Optional[PartialTxn] = None,
                  stable_deps: Optional[Deps] = None,
-                 writes: Optional[Writes] = None, result=None):
+                 writes: Optional[Writes] = None, result=None,
+                 invalid_if_undecided: bool = False):
         self.save_status = save_status
         self.promised = promised
         self.accepted = accepted
@@ -50,6 +51,11 @@ class CheckStatusOk(Reply):
         self.stable_deps = stable_deps
         self.writes = writes
         self.result = result
+        # durability-derived evidence this txn is headed for invalidation
+        # (coordinate/infer.py); steers the fetcher's escalation into the
+        # ballot-backed Invalidate round — NOT a licence to invalidate
+        # without one (see infer.py's safety note)
+        self.invalid_if_undecided = invalid_if_undecided
 
     def merge(self, other: "CheckStatusOk") -> "CheckStatusOk":
         """Field-wise maximum knowledge (CheckStatusOk.merge)."""
@@ -74,6 +80,8 @@ class CheckStatusOk(Reply):
             hi.stable_deps if hi.stable_deps is not None else lo.stable_deps,
             hi.writes if hi.writes is not None else lo.writes,
             hi.result if hi.result is not None else lo.result,
+            invalid_if_undecided=(self.invalid_if_undecided
+                                  or other.invalid_if_undecided),
         )
 
     def __repr__(self):
@@ -97,11 +105,15 @@ class CheckStatus(TxnRequest):
         self.include_info = include_info
 
     def apply(self, safe_store) -> Reply:
+        from accord_tpu.coordinate.infer import invalid_if_undecided
         cmd = safe_store.if_present(self.txn_id)
+        undecided = cmd is None or not cmd.save_status.is_decided
+        proof = (undecided and invalid_if_undecided(
+            safe_store, self.txn_id, self.scope.participants()))
         if cmd is None:
             return CheckStatusOk(SaveStatus.NOT_DEFINED, Ballot.ZERO,
                                  Ballot.ZERO, None, Durability.NOT_DURABLE,
-                                 None)
+                                 None, invalid_if_undecided=proof)
         full = self.include_info == IncludeInfo.ALL
         return CheckStatusOk(
             cmd.save_status, cmd.promised, cmd.accepted_ballot,
@@ -111,7 +123,8 @@ class CheckStatus(TxnRequest):
             partial_txn=cmd.partial_txn if full else None,
             stable_deps=cmd.stable_deps if full else None,
             writes=cmd.writes if full else None,
-            result=cmd.result if full else None)
+            result=cmd.result if full else None,
+            invalid_if_undecided=proof)
 
     def reduce(self, a: Reply, b: Reply) -> Reply:
         if isinstance(a, CheckStatusNack):

@@ -1,0 +1,80 @@
+"""Infer: durability-derived invalidation evidence (coordinate/infer.py).
+
+Reference model: accord/coordinate/Infer.java — CheckStatus replies carry
+invalid-if-undecided conditions from DurableBefore; the fetcher uses them to
+steer escalation toward the (ballot-backed) invalidation round.
+"""
+
+from accord_tpu.coordinate.errors import Invalidated
+from accord_tpu.coordinate.fetch import maybe_recover
+from accord_tpu.local.status import SaveStatus
+from accord_tpu.messages.checkstatus import CheckStatus, IncludeInfo
+from accord_tpu.messages.preaccept import PreAccept
+from accord_tpu.primitives.keys import Key, Ranges
+from accord_tpu.sim.cluster import SimCluster
+
+from tests.test_recover import abandoned_txn, rw_txn
+
+
+def advance_majority_bound(cluster, ranges, bound):
+    for node in cluster.nodes.values():
+        for store in node.command_stores.all():
+            store.durable_before.update(ranges, bound)
+
+
+class TestInferEvidence:
+    def test_checkstatus_reports_evidence_below_majority_bound(self):
+        cluster = SimCluster(n_nodes=3, seed=61)
+        txn_id, route, _ = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, PreAccept))
+        from accord_tpu.local.store import PreLoadContext, SafeCommandStore
+        node = cluster.node(2)
+        store = node.command_stores.all()[0]
+        safe = SafeCommandStore(store, PreLoadContext.empty())
+
+        req = CheckStatus(txn_id, route, IncludeInfo.ALL)
+        assert not req.apply(safe).invalid_if_undecided
+
+        store.durable_before.update(Ranges.of((0, 1000)), _bump(txn_id))
+        assert req.apply(safe).invalid_if_undecided
+
+    def test_decided_txn_never_carries_evidence(self):
+        """The per-store proof requires local undecidedness: a decided txn
+        below the bound reports no evidence."""
+        from tests.test_recover import run_txn
+        cluster = SimCluster(n_nodes=3, seed=62)
+        run_txn(cluster, 1, rw_txn([], {10: 7}))
+        node = cluster.node(1)
+        txn_id = next(tid for store in node.command_stores.all()
+                      for tid, cmd in store.commands.items()
+                      if cmd.save_status >= SaveStatus.PRE_COMMITTED)
+        cmd = next(cmd for store in node.command_stores.all()
+                   for tid, cmd in store.commands.items() if tid == txn_id)
+        from accord_tpu.local.store import PreLoadContext, SafeCommandStore
+        advance_majority_bound(cluster, Ranges.of((0, 1000)), _bump(txn_id))
+        store = node.command_stores.all()[0]
+        req = CheckStatus(txn_id, cmd.route, IncludeInfo.ALL)
+        safe = SafeCommandStore(store, PreLoadContext.empty())
+        assert not req.apply(safe).invalid_if_undecided
+
+    def test_maybe_recover_routes_evidence_to_invalidation(self):
+        """With the bound advanced past an abandoned unwitnessed txn, the
+        escalation invalidates (via the ballot round) instead of recovering
+        — even given a full route."""
+        cluster = SimCluster(n_nodes=3, seed=63)
+        txn_id, route, _ = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, PreAccept))
+        advance_majority_bound(cluster, Ranges.of((0, 1000)), _bump(txn_id))
+        res = maybe_recover(cluster.node(2), txn_id, route,
+                            SaveStatus.NOT_DEFINED)
+        assert cluster.process_until(lambda: res.is_done)
+        assert isinstance(res.failure(), Invalidated)
+        for n in cluster.nodes.values():
+            assert 7 not in (n.data_store.get(Key(10)) or ())
+
+
+def _bump(txn_id):
+    from accord_tpu.primitives.timestamp import TxnId
+    return TxnId(txn_id.epoch, txn_id.hlc + 1000, txn_id.flags, txn_id.node)
