@@ -10,7 +10,7 @@ out as plain ReadTxnData) and the ephemeral read round.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List
 
 from accord_tpu.coordinate.tracking import ReadTracker, RequestStatus
 
@@ -27,18 +27,19 @@ class ReadCoordinator:
         self.tracker = ReadTracker(topologies)
         self._send_read = send_read
         self._on_exhausted = on_exhausted
-        self.contacted: List[int] = []
         self.exhausted = False
 
-    def initial_contacts(self, prefer_first: Optional[Sequence[int]] = None
-                         ) -> List[int]:
-        """One replica per shard, topology-sorter order, self first.
-        Returns a copy — `contacted` keeps growing as retries fan out."""
-        prefer = list(prefer_first or ())
-        prefer += [self.node.id] + self.node.topology.sorter.sort(
+    @property
+    def contacted(self):
+        """Every node a read was (or is being) attempted against — the
+        tracker maintains this as contacts and alternatives are chosen."""
+        return self.tracker.contacted
+
+    def initial_contacts(self) -> List[int]:
+        """One replica per shard, topology-sorter order, self first."""
+        prefer = [self.node.id] + self.node.topology.sorter.sort(
             self.topologies.nodes(), self.topologies)
-        self.contacted = self.tracker.initial_contacts(prefer)
-        return list(self.contacted)
+        return self.tracker.initial_contacts(prefer)
 
     @property
     def has_all_data(self) -> bool:
@@ -60,5 +61,4 @@ class ReadCoordinator:
             self._on_exhausted()
             return
         for to in retry:
-            self.contacted.append(to)
             self._send_read(to)

@@ -1,0 +1,60 @@
+"""Native tier: C++ kernels for the protocol engine's hottest host loops.
+
+Built lazily on first import: `_sorted_arrays.cpp` is compiled with the
+ambient C++ toolchain into a cached shared object next to this file and
+loaded as `_accord_native`. Absence of a compiler (or any build/load
+failure) degrades silently to the pure-Python tier — the implementations
+are behaviourally identical (tests/test_sorted_arrays.py runs against
+whichever is active, and test_native.py cross-checks the two).
+
+Rebuilds happen automatically when the source is newer than the cached
+object.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+
+AVAILABLE = False
+_mod = None
+
+
+def _build_and_load():
+    here = os.path.dirname(__file__)
+    src = os.path.join(here, "_sorted_arrays.cpp")
+    out = os.path.join(here, f"_accord_native_{sys.version_info.major}"
+                             f"{sys.version_info.minor}.so")
+    if not os.path.exists(out) \
+            or os.path.getmtime(out) < os.path.getmtime(src):
+        include = sysconfig.get_paths()["include"]
+        cxx = os.environ.get("CXX", "g++")
+        # per-process temp name: concurrent first imports (multi-process
+        # runner, pytest-xdist) must not interleave writes before the
+        # atomic replace
+        tmp = f"{out}.{os.getpid()}.tmp"
+        cmd = [cxx, "-O2", "-shared", "-fPIC", f"-I{include}", src, "-o",
+               tmp]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+    spec = importlib.util.spec_from_file_location("_accord_native", out)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if os.environ.get("ACCORD_NO_NATIVE", "") != "1":
+    try:
+        _mod = _build_and_load()
+        AVAILABLE = True
+    except Exception:  # noqa: BLE001 — any failure means Python tier
+        _mod = None
+        AVAILABLE = False
+
+
+def get():
+    """The native module, or None when running on the Python tier."""
+    return _mod

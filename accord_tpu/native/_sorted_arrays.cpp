@@ -1,0 +1,193 @@
+/* Native sorted-array kernels — the framework's hottest host-side loops.
+ *
+ * Reference: accord/utils/SortedArrays.java:44 (linearUnion /
+ * linearIntersection / linearSubtract and the binary-search family). These
+ * run under every Keys/RoutingKeys/TxnId merge in the protocol engine, so
+ * they get a C implementation mirroring accord_tpu/utils/sorted_arrays.py
+ * exactly — including the identity-return convention of linear_union (one
+ * input subsuming the other is returned as the SAME object so singleton
+ * checks like KeyDeps.NONE keep working).
+ *
+ * Elements are arbitrary Python objects ordered via rich comparison (<),
+ * exactly like the Python tier; comparison errors propagate.
+ *
+ * Built on first import by accord_tpu/native/__init__.py (g++ into a cached
+ * shared object); everything falls back to the Python tier when no
+ * toolchain is present.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+namespace {
+
+/* a < b via rich comparison; -1 on error */
+inline int lt(PyObject *a, PyObject *b) {
+    return PyObject_RichCompareBool(a, b, Py_LT);
+}
+
+struct FastSeq {
+    PyObject *seq = nullptr;
+    PyObject **items = nullptr;
+    Py_ssize_t n = 0;
+
+    bool init(PyObject *obj) {
+        seq = PySequence_Fast(obj, "expected a sequence");
+        if (seq == nullptr) return false;
+        items = PySequence_Fast_ITEMS(seq);
+        n = PySequence_Fast_GET_SIZE(seq);
+        return true;
+    }
+    ~FastSeq() { Py_XDECREF(seq); }
+};
+
+PyObject *linear_union(PyObject *, PyObject *args) {
+    PyObject *ao, *bo;
+    if (!PyArg_ParseTuple(args, "OO", &ao, &bo)) return nullptr;
+    FastSeq a, b;
+    if (!a.init(ao) || !b.init(bo)) return nullptr;
+    if (a.n == 0) {
+        if (PyList_Check(bo)) { Py_INCREF(bo); return bo; }
+        return PySequence_List(bo);
+    }
+    if (b.n == 0) {
+        if (PyList_Check(ao)) { Py_INCREF(ao); return ao; }
+        return PySequence_List(ao);
+    }
+    PyObject *out = PyList_New(0);
+    if (out == nullptr) return nullptr;
+    Py_ssize_t i = 0, j = 0;
+    while (i < a.n && j < b.n) {
+        PyObject *x = a.items[i], *y = b.items[j];
+        int xy = lt(x, y);
+        if (xy < 0) goto fail;
+        if (xy) {
+            if (PyList_Append(out, x) < 0) goto fail;
+            ++i;
+        } else {
+            int yx = lt(y, x);
+            if (yx < 0) goto fail;
+            if (yx) {
+                if (PyList_Append(out, y) < 0) goto fail;
+                ++j;
+            } else {
+                if (PyList_Append(out, x) < 0) goto fail;
+                ++i; ++j;
+            }
+        }
+    }
+    for (; i < a.n; ++i)
+        if (PyList_Append(out, a.items[i]) < 0) goto fail;
+    for (; j < b.n; ++j)
+        if (PyList_Append(out, b.items[j]) < 0) goto fail;
+    return out;
+fail:
+    Py_DECREF(out);
+    return nullptr;
+}
+
+PyObject *linear_intersection(PyObject *, PyObject *args) {
+    PyObject *ao, *bo;
+    if (!PyArg_ParseTuple(args, "OO", &ao, &bo)) return nullptr;
+    FastSeq a, b;
+    if (!a.init(ao) || !b.init(bo)) return nullptr;
+    PyObject *out = PyList_New(0);
+    if (out == nullptr) return nullptr;
+    Py_ssize_t i = 0, j = 0;
+    while (i < a.n && j < b.n) {
+        PyObject *x = a.items[i], *y = b.items[j];
+        int xy = lt(x, y);
+        if (xy < 0) goto fail;
+        if (xy) { ++i; continue; }
+        int yx = lt(y, x);
+        if (yx < 0) goto fail;
+        if (yx) { ++j; continue; }
+        if (PyList_Append(out, x) < 0) goto fail;
+        ++i; ++j;
+    }
+    return out;
+fail:
+    Py_DECREF(out);
+    return nullptr;
+}
+
+PyObject *linear_subtract(PyObject *, PyObject *args) {
+    PyObject *ao, *bo;
+    if (!PyArg_ParseTuple(args, "OO", &ao, &bo)) return nullptr;
+    FastSeq a, b;
+    if (!a.init(ao) || !b.init(bo)) return nullptr;
+    PyObject *out = PyList_New(0);
+    if (out == nullptr) return nullptr;
+    Py_ssize_t i = 0, j = 0;
+    while (i < a.n && j < b.n) {
+        PyObject *x = a.items[i], *y = b.items[j];
+        int xy = lt(x, y);
+        if (xy < 0) goto fail;
+        if (xy) {
+            if (PyList_Append(out, x) < 0) goto fail;
+            ++i; continue;
+        }
+        int yx = lt(y, x);
+        if (yx < 0) goto fail;
+        if (yx) { ++j; continue; }
+        ++i; ++j;
+    }
+    for (; i < a.n; ++i)
+        if (PyList_Append(out, a.items[i]) < 0) goto fail;
+    return out;
+fail:
+    Py_DECREF(out);
+    return nullptr;
+}
+
+/* binary_search(xs, target, lo=0, hi=None) -> match index or
+ * -(insertion_point)-1, the Java convention the Python tier mirrors */
+PyObject *binary_search(PyObject *, PyObject *args) {
+    PyObject *xso, *target, *hio = Py_None;
+    Py_ssize_t lo = 0;
+    if (!PyArg_ParseTuple(args, "OO|nO", &xso, &target, &lo, &hio))
+        return nullptr;
+    FastSeq xs;
+    if (!xs.init(xso)) return nullptr;
+    Py_ssize_t hi = xs.n;
+    if (hio != Py_None) {
+        hi = PyNumber_AsSsize_t(hio, PyExc_OverflowError);
+        if (hi == -1 && PyErr_Occurred()) return nullptr;
+    }
+    while (lo < hi) {
+        Py_ssize_t mid = (lo + hi) / 2;
+        PyObject *v = xs.items[mid];
+        int vlt = lt(v, target);
+        if (vlt < 0) return nullptr;
+        if (vlt) { lo = mid + 1; continue; }
+        int tlt = lt(target, v);
+        if (tlt < 0) return nullptr;
+        if (tlt) hi = mid;
+        else return PyLong_FromSsize_t(mid);
+    }
+    return PyLong_FromSsize_t(-(lo + 1));
+}
+
+PyMethodDef methods[] = {
+    {"linear_union", linear_union, METH_VARARGS,
+     "union of two sorted unique sequences"},
+    {"linear_intersection", linear_intersection, METH_VARARGS,
+     "intersection of two sorted unique sequences"},
+    {"linear_subtract", linear_subtract, METH_VARARGS,
+     "difference of two sorted unique sequences"},
+    {"binary_search", binary_search, METH_VARARGS,
+     "Java-convention binary search"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_accord_native",
+    "native sorted-array kernels", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+extern "C" PyMODINIT_FUNC PyInit__accord_native(void) {
+    return PyModule_Create(&moduledef);
+}

@@ -182,3 +182,23 @@ def fold_intersection(a: Sequence, b: Sequence, fn: Callable, acc):
         acc = fn(acc, a[ai])
         pos = next_intersection(a, ai + 1, b, bi + 1)
     return acc
+
+
+# -- native tier --------------------------------------------------------------
+# The C++ mirrors (accord_tpu/native/_sorted_arrays.cpp) replace the merge
+# loops and binary search when a toolchain built them; semantics are
+# identical including linear_union's identity-return convention
+# (tests/test_native.py cross-checks both tiers). find_ceil/find_floor keep
+# their Python bodies but ride the native binary_search.
+
+from accord_tpu import native as _native  # noqa: E402
+
+if _native.AVAILABLE:  # pragma: no branch
+    _m = _native.get()
+    linear_union = _m.linear_union
+    linear_intersection = _m.linear_intersection
+    linear_subtract = _m.linear_subtract
+
+    def binary_search(xs, target, lo=0, hi=None,  # noqa: F811
+                      mode: Search = Search.FAST) -> int:
+        return _m.binary_search(xs, target, lo, hi)
