@@ -1,0 +1,164 @@
+"""AbstractConfigurationService: the epoch-history topology feed.
+
+Reference: accord/impl/AbstractConfigurationService.java — an ordered
+per-epoch ledger (received -> acknowledged async stages), listener fan-out,
+and gap-driven fetches: reporting epoch N when N-1 is unknown asks the
+transport to fetch the missing predecessors, so listeners always observe
+epochs in order. Transport-specific subclasses implement `fetch_topology`;
+the sim's subclass resolves against the cluster's ledger directly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from accord_tpu.api.spi import ConfigurationService, EpochReady
+from accord_tpu.utils import invariants
+from accord_tpu.utils.async_chains import AsyncResult
+
+
+class EpochState:
+    __slots__ = ("epoch", "received", "acknowledged", "topology")
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
+        self.received: AsyncResult = AsyncResult()      # -> Topology
+        self.acknowledged: AsyncResult = AsyncResult()  # -> None
+        self.topology = None
+
+    def __repr__(self):
+        return f"EpochState({self.epoch})"
+
+
+class EpochHistory:
+    """Contiguous epoch ledger (AbstractEpochHistory)."""
+
+    def __init__(self):
+        self._epochs: List[EpochState] = []
+        self.last_received = 0
+        self.last_acknowledged = 0
+
+    @property
+    def min_epoch(self) -> int:
+        return self._epochs[0].epoch if self._epochs else 0
+
+    @property
+    def max_epoch(self) -> int:
+        return self._epochs[-1].epoch if self._epochs else 0
+
+    def get_or_create(self, epoch: int) -> EpochState:
+        invariants.check_argument(epoch > 0, "epochs start at 1")
+        if not self._epochs:
+            self._epochs.append(EpochState(epoch))
+            return self._epochs[0]
+        # extend below / above so the ledger stays contiguous
+        while epoch < self._epochs[0].epoch:
+            self._epochs.insert(0, EpochState(self._epochs[0].epoch - 1))
+        while epoch > self._epochs[-1].epoch:
+            self._epochs.append(EpochState(self._epochs[-1].epoch + 1))
+        return self._epochs[epoch - self._epochs[0].epoch]
+
+    def get(self, epoch: int) -> Optional[EpochState]:
+        if not self._epochs \
+                or not self._epochs[0].epoch <= epoch <= self._epochs[-1].epoch:
+            return None
+        return self._epochs[epoch - self._epochs[0].epoch]
+
+    def truncate_until(self, epoch: int) -> None:
+        """Shed epochs below `epoch` (topology GC)."""
+        while self._epochs and self._epochs[0].epoch < epoch:
+            self._epochs.pop(0)
+
+
+class AbstractConfigurationService(ConfigurationService):
+    def __init__(self, local_id: int):
+        self.local_id = local_id
+        self.epochs = EpochHistory()
+        self.listeners: List = []
+        self._fetching: Dict[int, bool] = {}
+        self._delivered = 0  # highest epoch fanned out to listeners
+
+    # ---------------------------------------------------------------- query --
+    def current_topology(self):
+        e = self.epochs.get(self.epochs.last_received)
+        return e.topology if e is not None else None
+
+    def get_topology_for_epoch(self, epoch: int):
+        e = self.epochs.get(epoch)
+        return e.topology if e is not None else None
+
+    def register_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    # ----------------------------------------------------------------- feed --
+    def report_topology(self, topology, start_sync: bool = True) -> None:
+        """Record an epoch's topology; listeners observe epochs STRICTLY in
+        order — an epoch arriving above a gap is buffered in the ledger, the
+        missing predecessors are fetched, and delivery resumes once the
+        prefix is contiguous (AbstractConfigurationService.reportTopology)."""
+        epoch = topology.epoch
+        self._fetching.pop(epoch, None)
+        state = self.epochs.get_or_create(epoch)
+        if state.topology is not None:
+            return  # duplicate report
+        state.topology = topology
+        self.epochs.last_received = max(self.epochs.last_received, epoch)
+        state.received.try_success(topology)
+        self._deliver_contiguous(start_sync)
+
+    def _deliver_contiguous(self, start_sync: bool) -> None:
+        while True:
+            nxt = (self._delivered + 1 if self._delivered
+                   else self.epochs.min_epoch)
+            state = self.epochs.get(nxt)
+            if state is None:
+                return
+            if state.topology is None:
+                # a gap: acquire it, delivery resumes when it reports
+                self.fetch_topology_for_epoch(nxt)
+                return
+            self._delivered = nxt
+            for listener in self.listeners:
+                listener.on_topology_update(state.topology,
+                                            start_sync=start_sync)
+
+    def acknowledge_epoch(self, ready: EpochReady,
+                          start_sync: bool = True) -> None:
+        state = self.epochs.get_or_create(ready.epoch)
+        self.epochs.last_acknowledged = max(self.epochs.last_acknowledged,
+                                            ready.epoch)
+        state.acknowledged.try_success(None)
+
+    def fetch_topology_for_epoch(self, epoch: int) -> None:
+        if self.get_topology_for_epoch(epoch) is not None \
+                or self._fetching.get(epoch):
+            return
+        self._fetching[epoch] = True
+        self.fetch_topology(epoch)
+
+    # ------------------------------------------------------------ transport --
+    def fetch_topology(self, epoch: int) -> None:
+        """Transport hook: acquire `epoch` and call report_topology."""
+        raise NotImplementedError
+
+
+class DirectConfigService(AbstractConfigurationService):
+    """Sim/host service: fetches resolve against a shared topology ledger
+    (the cluster's, or the deterministically derived static topology)."""
+
+    def __init__(self, local_id: int, lookup=None):
+        super().__init__(local_id)
+        self._lookup = lookup  # epoch -> Topology | None
+
+    def fetch_topology(self, epoch: int) -> None:
+        if self._lookup is None:
+            self._fetching.pop(epoch, None)
+            return
+        topology = self._lookup(epoch)
+        if topology is None:
+            # not available yet: clear the in-flight flag so a later
+            # attempt can retry (a stuck flag would suppress the fetch
+            # forever and leave the gap unhealed)
+            self._fetching.pop(epoch, None)
+            return
+        self.report_topology(topology)

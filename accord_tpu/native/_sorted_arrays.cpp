@@ -154,6 +154,12 @@ PyObject *binary_search(PyObject *, PyObject *args) {
         hi = PyNumber_AsSsize_t(hio, PyExc_OverflowError);
         if (hi == -1 && PyErr_Occurred()) return nullptr;
     }
+    /* out-of-contract bounds raise exactly like the Python tier's xs[mid]
+     * would — never read outside the item array */
+    if (lo < 0 || hi > xs.n) {
+        PyErr_SetString(PyExc_IndexError, "binary_search bounds outside sequence");
+        return nullptr;
+    }
     while (lo < hi) {
         Py_ssize_t mid = (lo + hi) / 2;
         PyObject *v = xs.items[mid];

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional
 
 from accord_tpu.api.spi import Agent, EventsListener
+from accord_tpu.impl.config_service import DirectConfigService
 from accord_tpu.impl.list_store import ListStore
 from accord_tpu.local.node import Node
 from accord_tpu.primitives.keys import Range, Ranges
@@ -90,6 +91,9 @@ class SimCluster:
         rf = rf if rf is not None else n_nodes
         node_ids = list(range(1, n_nodes + 1))
         self.topology = self._make_topology(1, node_ids, n_shards, rf)
+        # epoch ledger backing each node's ConfigurationService fetches
+        self.topology_ledger: Dict[int, Topology] = {1: self.topology}
+        self.config_services: Dict[int, object] = {}
         for nid in node_ids:
             agent = SimAgent(self, nid)
             sink = NodeSink(nid, self.network)
@@ -107,7 +111,13 @@ class SimCluster:
             self.agents[nid] = agent
             self.nodes[nid] = node
             self.network.register(node)
-            node.on_topology_update(self.topology)
+            # topology flows through the node's ConfigurationService
+            # (reference AbstractConfigurationService): the node is a
+            # listener, the cluster ledger serves gap fetches
+            service = DirectConfigService(nid, self.topology_ledger.get)
+            service.register_listener(node)
+            self.config_services[nid] = service
+            service.report_topology(self.topology)
 
     def _make_topology(self, epoch: int, node_ids: List[int], n_shards: int,
                        rf: int) -> Topology:
@@ -121,8 +131,9 @@ class SimCluster:
 
     def update_topology(self, topology: Topology) -> None:
         self.topology = topology
-        for node in self.nodes.values():
-            node.on_topology_update(topology)
+        self.topology_ledger[topology.epoch] = topology
+        for service in self.config_services.values():
+            service.report_topology(topology)
 
     def start_durability_scheduling(self, shard_cycle_s: float = None,
                                     global_cycle_every: int = None) -> None:

@@ -45,6 +45,7 @@ class TopologyRandomizer:
         if new is not None:
             self.changes += 1
             self.cluster.topology = new
+            self.cluster.topology_ledger[new.epoch] = new
             for nid in self.cluster.nodes:
                 self._enqueue(nid, new)
         self.cluster.queue.add(self.period_us, self._tick)
@@ -63,7 +64,7 @@ class TopologyRandomizer:
         delay = 1000 + self.rng.next_int(200_000)  # 1ms..200ms
 
         def deliver():
-            self.cluster.nodes[nid].on_topology_update(topology)
+            self.cluster.config_services[nid].report_topology(topology)
             self._deliver_next(nid)
 
         self.cluster.queue.add(delay, deliver)

@@ -193,6 +193,13 @@ def fold_intersection(a: Sequence, b: Sequence, fn: Callable, acc):
 
 from accord_tpu import native as _native  # noqa: E402
 
+# the Python bodies stay reachable under these aliases so the native tier
+# can be cross-checked against the REAL fallback (tests/test_native.py)
+py_linear_union = linear_union
+py_linear_intersection = linear_intersection
+py_linear_subtract = linear_subtract
+py_binary_search = binary_search
+
 if _native.AVAILABLE:  # pragma: no branch
     _m = _native.get()
     linear_union = _m.linear_union

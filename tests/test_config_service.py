@@ -1,0 +1,69 @@
+"""ConfigurationService: the epoch-history topology feed.
+
+Reference model: accord/impl/AbstractConfigurationService.java — contiguous
+epoch ledger, listener fan-out, gap-driven fetches.
+"""
+
+from accord_tpu.impl.config_service import DirectConfigService, EpochHistory
+from accord_tpu.primitives.keys import Range
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+
+
+def topo(epoch):
+    return Topology(epoch, [Shard(Range(0, 100), [1, 2, 3])])
+
+
+class Recorder:
+    def __init__(self):
+        self.seen = []
+
+    def on_topology_update(self, topology, start_sync=True):
+        self.seen.append(topology.epoch)
+
+
+class TestEpochHistory:
+    def test_contiguous_ledger(self):
+        h = EpochHistory()
+        h.get_or_create(3)
+        h.get_or_create(6)
+        assert (h.min_epoch, h.max_epoch) == (3, 6)
+        assert [h.get(e).epoch for e in range(3, 7)] == [3, 4, 5, 6]
+        h.get_or_create(1)
+        assert h.min_epoch == 1
+        h.truncate_until(4)
+        assert h.min_epoch == 4
+        assert h.get(2) is None
+
+    def test_received_resolves(self):
+        svc = DirectConfigService(1)
+        state = svc.epochs.get_or_create(1)
+        assert not state.received.is_done
+        svc.report_topology(topo(1))
+        assert state.received.is_done
+        assert svc.current_topology().epoch == 1
+
+
+class TestDirectConfigService:
+    def test_listener_fanout_and_dedup(self):
+        svc = DirectConfigService(1)
+        rec = Recorder()
+        svc.register_listener(rec)
+        svc.report_topology(topo(1))
+        svc.report_topology(topo(1))  # duplicate report ignored
+        svc.report_topology(topo(2))
+        assert rec.seen == [1, 2]
+        assert svc.get_topology_for_epoch(1).epoch == 1
+        assert svc.epochs.last_received == 2
+
+    def test_gap_triggers_fetch(self):
+        ledger = {1: topo(1), 2: topo(2), 3: topo(3)}
+        svc = DirectConfigService(1, ledger.get)
+        rec = Recorder()
+        svc.register_listener(rec)
+        svc.report_topology(topo(1))
+        # epoch 3 arrives with 2 missing: the service fetches 2 from the
+        # transport; listeners still observe every epoch
+        svc.report_topology(topo(3))
+        assert 2 in rec.seen and 3 in rec.seen
+        assert svc.get_topology_for_epoch(2).epoch == 2

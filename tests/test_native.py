@@ -18,20 +18,12 @@ pytestmark = pytest.mark.skipif(not native.AVAILABLE,
                                 reason="no C++ toolchain")
 
 
-def py_union(a, b):
-    if not a:
-        return list(b)
-    if not b:
-        return list(a)
-    out, i, j = [], 0, 0
-    while i < len(a) and j < len(b):
-        if a[i] < b[j]:
-            out.append(a[i]); i += 1
-        elif b[j] < a[i]:
-            out.append(b[j]); j += 1
-        else:
-            out.append(a[i]); i += 1; j += 1
-    return out + list(a[i:]) + list(b[j:])
+from accord_tpu.utils.sorted_arrays import (py_binary_search,  # noqa: E402
+                                            py_linear_intersection,
+                                            py_linear_subtract,
+                                            py_linear_union)
+
+py_union = py_linear_union  # the REAL shipped fallback, not a test copy
 
 
 def sorted_unique():
@@ -45,6 +37,9 @@ class TestNativeKernels:
 
         def prop(a, b):
             assert m.linear_union(a, b) == py_union(a, b)
+            assert m.linear_intersection(a, b) == py_linear_intersection(a, b)
+            assert m.linear_subtract(a, b) == py_linear_subtract(a, b)
+            # and against independent set algebra
             assert m.linear_intersection(a, b) == sorted(set(a) & set(b))
             assert m.linear_subtract(a, b) == sorted(set(a) - set(b))
 
@@ -81,6 +76,22 @@ class TestNativeKernels:
                     break
             want = lo if lo < len(xs) and xs[lo] == target else -(lo + 1)
             assert m.binary_search(xs, target, 0, None) == want
+
+    def test_binary_search_matches_python_tier(self):
+        m = native.get()
+        xs = [2, 4, 6, 8, 11]
+        for target in range(13):
+            for lo in range(len(xs)):
+                for hi in (None, lo, len(xs)):
+                    assert m.binary_search(xs, target, lo, hi) \
+                        == py_binary_search(xs, target, lo, hi)
+
+    def test_out_of_bounds_raises(self):
+        m = native.get()
+        with pytest.raises(IndexError):
+            m.binary_search([1, 2, 3], 9, 0, 1000)
+        with pytest.raises(IndexError):
+            m.binary_search([1, 2, 3], 9, -2, None)
 
     def test_comparison_errors_propagate(self):
         m = native.get()
