@@ -12,7 +12,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Timeout
-from accord_tpu.coordinate.tracking import QuorumTracker, RequestStatus
+from accord_tpu.coordinate.tracking import (AppliedTracker, QuorumTracker,
+                                            RequestStatus)
 from accord_tpu.messages.accept import Accept, AcceptNack, AcceptOk
 from accord_tpu.messages.apply_msg import Apply, ApplyKind, ApplyReply
 from accord_tpu.messages.base import Callback, RoundCallback, TxnRequest
@@ -242,7 +243,7 @@ class ExecutePath(Callback):
         # apply acks are always tracked: a quorum per shard makes the txn
         # majority-durable, which is gossiped via InformDurable so progress
         # logs stand down (the reference Persist round, Persist.java)
-        self.applied_tracker = QuorumTracker(topologies)
+        self.applied_tracker = AppliedTracker(topologies)
         apply_cb = RoundCallback(self, "apply")
         for to in topologies.nodes():
             scope = TxnRequest.compute_scope(to, topologies, self.route)

@@ -13,14 +13,14 @@ from typing import Dict, Optional
 
 from accord_tpu.coordinate.errors import Exhausted, Timeout
 from accord_tpu.coordinate.tracking import QuorumTracker, RequestStatus
-from accord_tpu.local.status import SaveStatus
+from accord_tpu.local.status import Durability, ProgressToken, SaveStatus
 from accord_tpu.messages.base import Callback, TxnRequest
 from accord_tpu.messages.checkstatus import (CheckStatus, CheckStatusNack,
                                              CheckStatusOk, IncludeInfo)
 from accord_tpu.messages.propagate import Propagate
 from accord_tpu.primitives.keys import Route
 from accord_tpu.primitives.timestamp import NONE as TS_NONE
-from accord_tpu.primitives.timestamp import TxnId
+from accord_tpu.primitives.timestamp import Ballot, TxnId
 from accord_tpu.utils.async_chains import AsyncResult
 
 
@@ -186,13 +186,21 @@ def fetch_max_conflict(node, route: Route, participants) -> AsyncResult:
 
 
 def maybe_recover(node, txn_id: TxnId, route: Route,
-                  prev_status: SaveStatus) -> AsyncResult:
+                  prev_progress) -> AsyncResult:
     """Home-shard liveness check: if anyone has moved the txn past
-    `prev_status`, just absorb that knowledge; otherwise drive Recover —
-    or, when nobody we can reach knows the full route and the outcome is
-    still undecidable, the multi-shard Invalidate round, which either kills
-    the txn or discovers the route and recovers
-    (coordinate/MaybeRecover.java:95-105)."""
+    `prev_progress` (a ProgressToken, or a bare SaveStatus which is widened
+    to one — durability/ballot movement counts as progress even when the
+    status has not advanced, MaybeRecover.hasMadeProgress), just absorb that
+    knowledge; otherwise drive Recover — or, when nobody we can reach knows
+    the full route and the outcome is still undecidable, the multi-shard
+    Invalidate round, which either kills the txn or discovers the route and
+    recovers (coordinate/MaybeRecover.java:95-105)."""
+    if isinstance(prev_progress, SaveStatus):
+        # widen with the SAME rule token sources use (ProgressToken.of), so
+        # a txn genuinely stuck at prev_progress compares equal, not below
+        prev_progress = ProgressToken.of(Durability.NOT_DURABLE,
+                                         prev_progress, Ballot.ZERO,
+                                         Ballot.ZERO)
     result: AsyncResult = AsyncResult()
 
     def on_checked(merged: Optional[CheckStatusOk], failure):
@@ -200,7 +208,8 @@ def maybe_recover(node, txn_id: TxnId, route: Route,
             result.try_failure(failure)
             return
         progressed = merged is not None and (
-            merged.save_status > prev_status or merged.is_coordinating)
+            merged.to_progress_token() > prev_progress
+            or merged.is_coordinating)
         if progressed:
             if merged.save_status > SaveStatus.NOT_DEFINED:
                 full = merged.route if merged.route is not None else route

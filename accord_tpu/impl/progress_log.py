@@ -16,7 +16,7 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from accord_tpu.api.spi import ProgressLog
-from accord_tpu.local.status import SaveStatus
+from accord_tpu.local.status import ProgressToken, SaveStatus
 from accord_tpu.primitives.keys import Route
 from accord_tpu.primitives.timestamp import TxnId
 
@@ -25,17 +25,22 @@ class _HomeState:
     """Progress tracking for a txn this store is home for
     (SimpleProgressLog.CoordinateState)."""
 
-    __slots__ = ("txn_id", "route", "status", "updated_at_s", "attempts",
+    __slots__ = ("txn_id", "route", "token", "updated_at_s", "attempts",
                  "investigating")
 
-    def __init__(self, txn_id: TxnId, route: Optional[Route], status: SaveStatus,
-                 now_s: float):
+    def __init__(self, txn_id: TxnId, route: Optional[Route],
+                 token: ProgressToken, now_s: float):
         self.txn_id = txn_id
         self.route = route
-        self.status = status
+        self.token = token
         self.updated_at_s = now_s
         self.attempts = 0
         self.investigating = False
+
+
+def _token_of(command) -> ProgressToken:
+    return ProgressToken.of(command.durability, command.save_status,
+                            command.promised, command.accepted_ballot)
 
 
 class _BlockedState:
@@ -83,11 +88,13 @@ class SimpleProgressLog(ProgressLog):
         if not self._is_home(command):
             return
         state = self.home.get(txn_id)
+        token = _token_of(command)
         if state is None:
-            self.home[txn_id] = _HomeState(txn_id, command.route,
-                                           command.save_status, now)
-        elif command.save_status != state.status:
-            state.status = command.save_status
+            self.home[txn_id] = _HomeState(txn_id, command.route, token, now)
+        elif token != state.token:
+            # ANY movement — durability, phase, or a fresh promise — resets
+            # the escalation backoff (ProgressToken comparison)
+            state.token = token
             state.route = command.route or state.route
             state.updated_at_s = now
             state.attempts = 0
@@ -133,7 +140,7 @@ class SimpleProgressLog(ProgressLog):
         # to a recovery ballot if nobody did (MaybeRecover.java)
         from accord_tpu.coordinate.fetch import maybe_recover
         maybe_recover(self.node, state.txn_id, state.route,
-                      state.status).add_callback(
+                      state.token).add_callback(
             lambda v, f: self._done_home(state))
 
     def _done_home(self, state: _HomeState) -> None:

@@ -153,6 +153,68 @@ class Durability(enum.IntEnum):
         return self >= Durability.MAJORITY
 
 
+class ProgressToken:
+    """Comparable progress summary (primitives/ProgressToken.java): ordered
+    by durability, then status, then promised ballot, then whether the
+    promise was accepted — so a liveness monitor can tell 'someone is
+    moving this txn' even when only durability or a ballot advanced."""
+
+    __slots__ = ("durability", "status", "promised", "is_accepted")
+
+    NONE: "ProgressToken"
+
+    def __init__(self, durability: "Durability", status: "SaveStatus",
+                 promised, is_accepted: bool):
+        self.durability = durability
+        self.status = status
+        self.promised = promised
+        self.is_accepted = is_accepted
+
+    @classmethod
+    def of(cls, durability: "Durability", status: "SaveStatus", promised,
+           accepted) -> "ProgressToken":
+        """The one place the is-accepted rule lives: the promise counts as
+        accepted once the Accept phase ratified that very ballot."""
+        return cls(durability, status, promised,
+                   status.phase >= Phase.ACCEPT and accepted == promised)
+
+    @property
+    def phase(self) -> Phase:
+        return self.status.phase
+
+    def _key(self):
+        return (self.durability, self.status, self.promised,
+                self.is_accepted)
+
+    def __lt__(self, other):
+        return self._key() < other._key()
+
+    def __le__(self, other):
+        return self._key() <= other._key()
+
+    def __gt__(self, other):
+        return self._key() > other._key()
+
+    def __ge__(self, other):
+        return self._key() >= other._key()
+
+    def __eq__(self, other):
+        return isinstance(other, ProgressToken) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return (f"ProgressToken({self.durability.name}, {self.status.name}, "
+                f"{self.promised!r}{', accepted' if self.is_accepted else ''})")
+
+
+def _progress_token_none() -> ProgressToken:
+    from accord_tpu.primitives.timestamp import Ballot
+    return ProgressToken.of(Durability.NOT_DURABLE, SaveStatus.NOT_DEFINED,
+                            Ballot.ZERO, Ballot.ZERO)
+
+
 class KnownRoute(enum.IntEnum):
     MAYBE = 0
     COVERING = 1
@@ -257,3 +319,5 @@ KNOWN_STABLE = Known(KnownRoute.COVERING, KnownDefinition.YES,
                      KnownOutcome.UNKNOWN)
 KNOWN_APPLY = Known(KnownRoute.COVERING, KnownDefinition.YES,
                     KnownExecuteAt.YES, KnownDeps.STABLE, KnownOutcome.APPLY)
+
+ProgressToken.NONE = _progress_token_none()

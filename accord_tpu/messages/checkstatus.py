@@ -11,7 +11,7 @@ from __future__ import annotations
 import enum
 from typing import Optional
 
-from accord_tpu.local.status import Durability, SaveStatus
+from accord_tpu.local.status import Durability, ProgressToken, SaveStatus
 from accord_tpu.messages.base import MessageType, Reply, TxnRequest
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keys import Route
@@ -83,6 +83,12 @@ class CheckStatusOk(Reply):
             invalid_if_undecided=(self.invalid_if_undecided
                                   or other.invalid_if_undecided),
         )
+
+    def to_progress_token(self) -> ProgressToken:
+        """Progress summary for liveness comparisons
+        (CheckStatusOk.toProgressToken)."""
+        return ProgressToken.of(self.durability, self.save_status,
+                                self.promised, self.accepted)
 
     def __repr__(self):
         return (f"CheckStatusOk({self.save_status.name}, "
