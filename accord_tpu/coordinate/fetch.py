@@ -188,16 +188,27 @@ def fetch_max_conflict(node, route: Route, participants) -> AsyncResult:
 def maybe_recover(node, txn_id: TxnId, route: Route,
                   prev_progress) -> AsyncResult:
     """Home-shard liveness check: if anyone has moved the txn past
-    `prev_progress` (a ProgressToken, or a bare SaveStatus which is widened
-    to one — durability/ballot movement counts as progress even when the
-    status has not advanced, MaybeRecover.hasMadeProgress), just absorb that
-    knowledge; otherwise drive Recover — or, when nobody we can reach knows
-    the full route and the outcome is still undecidable, the multi-shard
-    Invalidate round, which either kills the txn or discovers the route and
-    recovers (coordinate/MaybeRecover.java:95-105)."""
-    if isinstance(prev_progress, SaveStatus):
-        # widen with the SAME rule token sources use (ProgressToken.of), so
-        # a txn genuinely stuck at prev_progress compares equal, not below
+    `prev_progress` (a ProgressToken; None means no prior knowledge, i.e.
+    ProgressToken.NONE; a bare SaveStatus is widened with zero ballots —
+    durability/ballot movement counts as progress even when the status has
+    not advanced, MaybeRecover.hasMadeProgress), absorb that knowledge;
+    otherwise drive Recover — or, when nobody we can reach knows the full
+    route and the outcome is still undecidable, the multi-shard Invalidate
+    round, which either kills the txn or discovers the route and recovers
+    (coordinate/MaybeRecover.java:95-105).
+
+    Single-call contract: "progressed" means the merged remote state exceeds
+    the BASELINE the caller passed — so a remote recovery ballot the caller
+    did not know about counts, by design.  A persistent monitor re-probing
+    the same txn must therefore pass a full ProgressToken and absorb the
+    observed token between probes (SimpleProgressLog._done_home does), or an
+    unchanged dead-recoverer ballot would read as fresh progress forever."""
+    if prev_progress is None:
+        prev_progress = ProgressToken.NONE
+    elif isinstance(prev_progress, SaveStatus):
+        # widen with the SAME rule token sources use (ProgressToken.of);
+        # zero ballots: the caller claims no ballot knowledge, so any
+        # outstanding promise reads as progress (see contract above)
         prev_progress = ProgressToken.of(Durability.NOT_DURABLE,
                                          prev_progress, Ballot.ZERO,
                                          Ballot.ZERO)

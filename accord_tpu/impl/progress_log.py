@@ -91,9 +91,14 @@ class SimpleProgressLog(ProgressLog):
         token = _token_of(command)
         if state is None:
             self.home[txn_id] = _HomeState(txn_id, command.route, token, now)
-        elif token != state.token:
-            # ANY movement — durability, phase, or a fresh promise — resets
-            # the escalation backoff (ProgressToken comparison)
+        elif token > state.token:
+            # movement — durability, phase, or a fresh promise — resets the
+            # escalation backoff.  Raise-only: state.token may hold a
+            # REMOTELY-observed ballot floor absorbed by _done_home that no
+            # local token can contain (Propagate never applies ballots);
+            # lowering it would re-read that stale remote ballot as fresh
+            # progress on every probe.  Ballot ranks below status in the
+            # token order, so genuine local progress still raises the floor.
             state.token = token
             state.route = command.route or state.route
             state.updated_at_s = now
@@ -141,11 +146,22 @@ class SimpleProgressLog(ProgressLog):
         from accord_tpu.coordinate.fetch import maybe_recover
         maybe_recover(self.node, state.txn_id, state.route,
                       state.token).add_callback(
-            lambda v, f: self._done_home(state))
+            lambda v, f: self._done_home(state, v))
 
-    def _done_home(self, state: _HomeState) -> None:
+    def _done_home(self, state: _HomeState, observed=None) -> None:
         state.investigating = False
         state.updated_at_s = self._now_s()
+        # Absorb remotely-observed movement: Propagate applies status and
+        # outcome knowledge but never ballots, so a dead coordinator's
+        # promise would read as fresh "progress" on EVERY poll and the txn
+        # would never escalate to Recover.  Raising our comparison floor to
+        # the observed token means an unchanged remote state compares equal
+        # next poll and recovery proceeds (MaybeRecover.hasMadeProgress
+        # records the observed ProgressToken the same way).
+        if observed is not None and hasattr(observed, "to_progress_token"):
+            token = observed.to_progress_token()
+            if token > state.token:
+                state.token = token
 
     def _walk_to_root_blocker(self, txn_id: TxnId) -> TxnId:
         """Follow the WaitingOn chain to the lowest unresolved dependency

@@ -18,6 +18,12 @@ Sharding partitions the key/entry axis across a jax Mesh — the same axis
 Accord shards CommandStores on — with psum/all-reduce to combine per-shard
 dependency sets (ops/sharded.py).
 
+Both hot ops additionally have hand-written Pallas TPU kernels
+(ops/pallas_kernels.py): the wavefront fixpoint runs entirely in VMEM (used
+by resolve_step on real TPU), and the deps tile rides the MXU via a one-hot
+contraction in place of the gather.  They are bit-identical drop-ins,
+verified in tests/test_pallas.py.
+
 Every kernel has a scalar oracle and must stay bit-identical to the host
 path (tests/test_ops.py).
 """
@@ -27,9 +33,25 @@ from accord_tpu.ops.deps_kernel import batched_active_deps, in_batch_graph
 from accord_tpu.ops.wavefront import execution_waves, waves_oracle
 from accord_tpu.ops.sharded import make_sharded_step, resolve_step
 
+_PALLAS_EXPORTS = ("batched_active_deps_pallas", "execution_waves_pallas",
+                   "resolve_step_pallas")
+
+# NOTE: the pallas names are deliberately NOT in __all__ — a star-import
+# resolves every __all__ entry and would defeat the lazy import below.
 __all__ = [
     "BatchEncoder", "DeviceState", "DeviceBatch",
     "batched_active_deps", "in_batch_graph",
     "execution_waves", "waves_oracle",
     "make_sharded_step", "resolve_step",
 ]
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): importing the package must not pull in
+    # jax.experimental.pallas — CPU-only hosts and the burn harness use only
+    # the XLA path, and sharded._waves_impl imports the kernels only when
+    # the backend is really a TPU.
+    if name in _PALLAS_EXPORTS:
+        from accord_tpu.ops import pallas_kernels
+        return getattr(pallas_kernels, name)
+    raise AttributeError(name)

@@ -1,0 +1,224 @@
+"""Pallas TPU kernels for the two hot ops.
+
+The jnp formulations (ops/deps_kernel.py, ops/wavefront.py) are the
+semantic reference; these kernels are drop-in replacements that must stay
+bit-identical (all logic is integer/boolean compares — no rounding anywhere
+— so "identical" is checkable with ==, and tests/test_pallas.py does).
+
+Why Pallas here:
+
+* ``execution_waves_pallas`` — the wavefront loop (reference
+  accord/local/Commands.java:656 maybeExecute / Command.java:1294 WaitingOn,
+  batched as Kahn layering) iterates up to longest-chain times over the same
+  [B, B] dependency matrix.  Under XLA's ``while_loop`` every iteration
+  re-reads the matrix from HBM; here the matrix is converted to f32 ONCE
+  into a VMEM scratch and the whole fixpoint runs on-chip — HBM traffic
+  drops from (waves x B^2) to (B^2 read + B write).
+
+* ``deps_tile_pallas`` — the [B, E] dependency-mask tile (reference
+  CommandsForKey.java:614-650 mapReduceActive, batched) as one predicated
+  pass.  The hot trick: the per-entry touch gather ``touches[b, key(e)]``
+  — a 67M-element dynamic gather in the XLA path, the slowest op on TPU —
+  is recast as a one-hot matmul on the MXU.  Each entry has exactly one
+  key, so every one-hot column holds a single 1 and the bf16 dot product
+  ``touches @ onehot(key)`` reproduces the gather EXACTLY (one-term sums of
+  0/1 need no precision).  The one-hot tile is built on-chip from the
+  entry-key block (never materialised in HBM), and the compare/elision
+  logic fuses onto the matmul result in the same kernel — no [B, E]
+  intermediates ever leave VMEM.
+
+Both kernels run under ``interpret=True`` on CPU (used by tests and by the
+multichip dryrun harness) and compile with Mosaic on real TPU.  VMEM bounds:
+the wavefront holds B^2 f32 + carries, so B is capped at 1024 (4 MB) —
+``execution_waves`` auto-falls back to the XLA path above that.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from accord_tpu.ops.deps_kernel import (_BIG, _APPLIED, _COMMITTED,
+                                        _TRANSITIVELY_KNOWN,
+                                        _successor_write_eat)
+from accord_tpu.ops.encode import STATUS_INACTIVE, WRITE_KIND_MASK
+
+# f32 holds integers exactly below 2^24; wave counts and dep-row sums are
+# bounded by B <= _MAX_WAVEFRONT_B, far inside that.
+_MAX_WAVEFRONT_B = 1024
+
+
+# ------------------------------------------------------------ wavefront ----
+
+def _waves_kernel(dep_ref, wave_ref, depf, total, assigned, wave):
+    """Whole-matrix VMEM fixpoint.  Scratch: depf [B,B] f32, total/assigned/
+    wave [B,1] — column layout so every step is a VPU broadcast-reduce."""
+    depf[:] = dep_ref[:].astype(jnp.float32)
+    total[:] = jnp.sum(depf[:], axis=1, keepdims=True)
+    b = dep_ref.shape[0]
+    wave[:] = jnp.full((b, 1), -1, jnp.int32)
+    assigned[:] = jnp.zeros((b, 1), jnp.float32)
+
+    def cond(it):
+        return jnp.logical_and(jnp.sum(assigned[:]) < b, it <= b)
+
+    def body(it):
+        # done[b] = how many of b's deps are already assigned a wave
+        done = jnp.sum(depf[:] * assigned[:].reshape(1, b), axis=1,
+                       keepdims=True)
+        ready = (assigned[:] == 0.0) & (done == total[:])
+        wave[:] = jnp.where(ready, it, wave[:])
+        assigned[:] = jnp.where(ready, 1.0, assigned[:])
+        return it + 1
+
+    jax.lax.while_loop(cond, body, jnp.int32(0))
+    wave_ref[:] = wave[:]
+
+
+def _waves_pallas_call(dep_bb: jax.Array, interpret: bool) -> jax.Array:
+    n = dep_bb.shape[0]
+    out = pl.pallas_call(
+        _waves_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((n, n), jnp.float32),
+            pltpu.VMEM((n, 1), jnp.float32),
+            pltpu.VMEM((n, 1), jnp.float32),
+            pltpu.VMEM((n, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(dep_bb.astype(jnp.int8))
+    return out.reshape(n)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def execution_waves_pallas(dep_bb: jax.Array,
+                           interpret: bool = False) -> jax.Array:
+    """dep_bb[B, B] bool -> wave[B] i32; bit-identical to
+    ops.wavefront.execution_waves."""
+    if dep_bb.shape[0] > _MAX_WAVEFRONT_B:
+        from accord_tpu.ops.wavefront import execution_waves
+        return execution_waves(dep_bb)
+    return _waves_pallas_call(dep_bb, interpret)
+
+
+# ------------------------------------------------------------ deps tile ----
+
+_TB = 128   # txn-tile (sublanes)
+_TE = 128   # entry-tile (lanes)
+
+
+_MAX_DEPS_K = 16384   # onehot tile K x TE bf16 caps VMEM at 4 MB
+
+
+def _deps_kernel(touches_ref, ekey_ref, erank_ref, eeat_ref, estatus_ref,
+                 ekind_ref, succ_ref, trank_ref, twit_ref, dep_ref):
+    """One (TB, TE) tile of the dependency mask.
+
+    Row blocks (txn axis) arrive as [1, TB] and are transposed to columns;
+    entry blocks are [1, TE] rows; the touch gather rides the MXU as a
+    one-hot matmul; all compares broadcast to [TB, TE] and fuse on the VPU."""
+    trank = trank_ref[0, :].reshape(_TB, 1)
+    twit = twit_ref[0, :].reshape(_TB, 1)
+    erank = erank_ref[0, :].reshape(1, _TE)
+    eeat = eeat_ref[0, :].reshape(1, _TE)
+    estatus = estatus_ref[0, :].reshape(1, _TE)
+    ekind = ekind_ref[0, :].reshape(1, _TE)
+    succ = succ_ref[0, :].reshape(1, _TE)
+
+    # touch[b, e] = touches[b, key(e)] as a one-hot contraction: column e of
+    # `onehot` has its single 1 at row key(e), so the (b, e) dot product is
+    # the one-term sum touches[b, key(e)] — exact in bf16.
+    k = touches_ref.shape[1]
+    kiota = jax.lax.broadcasted_iota(jnp.int32, (k, _TE), 0)
+    onehot = (kiota == ekey_ref[0, :].reshape(1, _TE)).astype(jnp.bfloat16)
+    touch = jnp.dot(touches_ref[:].astype(jnp.bfloat16), onehot,
+                    preferred_element_type=jnp.float32) > 0.5
+
+    earlier = erank < trank
+    witnessed = ((twit >> ekind) & 1) == 1
+    active = (erank >= 0) & (estatus > _TRANSITIVELY_KNOWN) \
+        & (estatus != STATUS_INACTIVE)
+    base = touch & earlier & witnessed & active
+
+    committed = (estatus >= _COMMITTED) & (estatus <= _APPLIED) & (erank >= 0)
+    elided = committed & (succ > eeat) & (succ < trank)
+
+    dep_ref[:] = (base & ~elided).astype(jnp.int8)
+
+
+def _deps_pallas_call(touches, entry_key, erank, eeat, estatus, ekind, succ,
+                      trank, twit, interpret: bool):
+    b, e = trank.shape[0], erank.shape[0]
+    k = touches.shape[1]
+    grid = (b // _TB, e // _TE)
+    row = lambda i, j: (0, i)      # [1, TB] txn blocks, keyed by txn tile
+    col = lambda i, j: (0, j)      # [1, TE] entry blocks, keyed by entry tile
+    vec = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _deps_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, e), jnp.int8),
+        grid=grid,
+        in_specs=[
+            vec((_TB, k), lambda i, j: (i, 0)),   # j-invariant: no refetch
+            vec((1, _TE), col), vec((1, _TE), col), vec((1, _TE), col),
+            vec((1, _TE), col), vec((1, _TE), col), vec((1, _TE), col),
+            vec((1, _TB), row), vec((1, _TB), row),
+        ],
+        out_specs=vec((_TB, _TE), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(touches.astype(jnp.int8), entry_key.reshape(1, e),
+      erank.reshape(1, e), eeat.reshape(1, e), estatus.reshape(1, e),
+      ekind.reshape(1, e), succ.reshape(1, e),
+      trank.reshape(1, b), twit.reshape(1, b))
+    return out.astype(jnp.bool_)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def batched_active_deps_pallas(entry_rank, entry_eat_rank, entry_key,
+                               entry_status, entry_kind, txn_rank,
+                               txn_witness_mask, touches,
+                               interpret: bool = False):
+    """Drop-in for ops.deps_kernel.batched_active_deps (same signature plus
+    `interpret`); the succ_w precomputation (a sort + segmented scan — XLA
+    territory) and the touch gather stay outside, the [B, E] tile runs in
+    the kernel."""
+    b, e = txn_rank.shape[0], entry_rank.shape[0]
+    if b % _TB or e % _TE or touches.shape[1] > _MAX_DEPS_K:
+        # encoders pad to 128 and bound K; belt and braces
+        from accord_tpu.ops.deps_kernel import batched_active_deps
+        return batched_active_deps(entry_rank, entry_eat_rank, entry_key,
+                                   entry_status, entry_kind, txn_rank,
+                                   txn_witness_mask, touches)
+    committed = (entry_status >= _COMMITTED) & (entry_status <= _APPLIED) \
+        & (entry_rank >= 0)
+    is_write = ((WRITE_KIND_MASK >> entry_kind) & 1) == 1
+    write_eat = jnp.where(committed & is_write, entry_eat_rank, _BIG)
+    succ_w = _successor_write_eat(entry_key, entry_eat_rank, write_eat)
+    dep = _deps_pallas_call(touches, entry_key, entry_rank, entry_eat_rank,
+                            entry_status, entry_kind, succ_w, txn_rank,
+                            txn_witness_mask, interpret)
+    return dep, dep.sum(axis=1, dtype=jnp.int32)
+
+
+# ---------------------------------------------------------- fused step -----
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def resolve_step_pallas(entry_rank, entry_eat_rank, entry_key, entry_status,
+                        entry_kind, txn_rank, txn_witness_mask, txn_kind,
+                        touches, interpret: bool = False):
+    """The full single-chip pipeline with both hot ops on Pallas; same
+    contract as ops.sharded.resolve_step."""
+    from accord_tpu.ops.deps_kernel import in_batch_graph
+    dep_mask, dep_count = batched_active_deps_pallas(
+        entry_rank, entry_eat_rank, entry_key, entry_status, entry_kind,
+        txn_rank, txn_witness_mask, touches, interpret=interpret)
+    dep_bb = in_batch_graph(txn_rank, txn_witness_mask, txn_kind, touches)
+    waves = execution_waves_pallas(dep_bb, interpret=interpret)
+    return dep_mask, dep_count, dep_bb, waves

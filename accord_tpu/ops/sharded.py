@@ -37,6 +37,18 @@ from accord_tpu.primitives.keys import Key
 from accord_tpu.primitives.timestamp import TxnId
 
 
+def _waves_impl(dep_bb):
+    """Trace-time backend dispatch for the wavefront: on real TPU the Pallas
+    kernel keeps the [B, B] matrix VMEM-resident across fixpoint iterations
+    (measured ~1.9x over the XLA while_loop on deep chains, parity on shallow
+    graphs — ops/pallas_kernels.py); elsewhere (CPU mesh tests, virtual
+    devices) the XLA formulation runs."""
+    if jax.default_backend() == "tpu":
+        from accord_tpu.ops.pallas_kernels import execution_waves_pallas
+        return execution_waves_pallas(dep_bb)
+    return execution_waves(dep_bb)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def resolve_step(entry_rank, entry_eat_rank, entry_key, entry_status,
                  entry_kind, txn_rank, txn_witness_mask, txn_kind, touches):
@@ -45,7 +57,7 @@ def resolve_step(entry_rank, entry_eat_rank, entry_key, entry_status,
         entry_rank, entry_eat_rank, entry_key, entry_status, entry_kind,
         txn_rank, txn_witness_mask, touches)
     dep_bb = in_batch_graph(txn_rank, txn_witness_mask, txn_kind, touches)
-    waves = execution_waves(dep_bb)
+    waves = _waves_impl(dep_bb)
     return dep_mask, dep_count, dep_bb, waves
 
 

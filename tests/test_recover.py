@@ -236,6 +236,60 @@ class TestProgressLog:
             max_items=500_000)
         assert done, "progress log failed to recover the stuck txn"
 
+    def test_probe_absorbs_remote_ballot_token(self):
+        """A remote recovery ballot Propagate cannot apply locally (it moves
+        no status) must not read as fresh 'progress' on every poll — the
+        monitor absorbs the observed token so an unchanged remote state
+        escalates to Recover next time instead of looping forever."""
+        from accord_tpu.impl.progress_log import _HomeState
+        from accord_tpu.local.status import (Durability, ProgressToken,
+                                             SaveStatus)
+        from accord_tpu.primitives.timestamp import Ballot
+
+        cluster = SimCluster(n_nodes=3, seed=5,
+                             progress_log_factory=SimpleProgressLog)
+        node1 = cluster.node(1)
+        store = node1.command_stores.all()[0]
+        log = node1.progress_log_for(store)
+        txn_id = node1.next_txn_id(TxnKind.WRITE, Domain.KEY)
+        local = ProgressToken.of(Durability.NOT_DURABLE,
+                                 SaveStatus.PRE_ACCEPTED,
+                                 Ballot.ZERO, Ballot.ZERO)
+        state = _HomeState(txn_id, None, local, 0.0)
+        remote_ballot = Ballot(1, 50, 0, 2)
+        observed_token = ProgressToken.of(Durability.NOT_DURABLE,
+                                          SaveStatus.PRE_ACCEPTED,
+                                          remote_ballot, Ballot.ZERO)
+        assert observed_token > state.token  # reads as progress once ...
+
+        class Observed:
+            def to_progress_token(self):
+                return observed_token
+
+        log._done_home(state, Observed())
+        assert not state.investigating
+        # ... but the floor is raised: the same observation is no longer
+        # "progressed", so the next probe drives Recover
+        assert not (observed_token > state.token)
+
+        # and a local no-op update (duplicate message churn) must not lower
+        # the absorbed floor / reset the escalation backoff
+        log.home[txn_id] = state
+        state.attempts = 3
+
+        class Cmd:
+            is_applied_or_gone = False
+            durability = Durability.NOT_DURABLE
+            save_status = SaveStatus.PRE_ACCEPTED
+            promised = Ballot.ZERO
+            accepted_ballot = Ballot.ZERO
+            route = None
+
+        log._is_home = lambda cmd: True
+        log.update(store, txn_id, Cmd())
+        assert state.token == observed_token, "floor was lowered"
+        assert state.attempts == 3, "backoff was reset by non-progress"
+
     def test_progress_log_chases_blocked_dependency(self):
         """A later txn stably depends on a stuck txn; the blocked replica's
         progress log recovers the dependency so the dependent can execute."""
