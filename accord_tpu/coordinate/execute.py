@@ -78,7 +78,89 @@ class Propose(Callback):
         self.oks[from_id] = reply
         if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
             self.done = True
-            self._on_accepted(Deps.merge([ok.deps for ok in self.oks.values()]))
+            from accord_tpu.utils.faults import FAULTS
+            if FAULTS.unmerged_deps(self.txn_id.kind):
+                # fault injection: drop the accept-round recalculations —
+                # the pre-accept deps alone must still be safe
+                self._on_accepted(self.deps)
+            else:
+                self._on_accepted(self.deps.with_(
+                    Deps.merge([ok.deps for ok in self.oks.values()])))
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        if self.tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self.done = True
+            self._on_failed(failure if isinstance(failure, Timeout)
+                            else Exhausted(repr(failure)))
+
+
+class Stabilise(Callback):
+    """Pre-execution commit round (Stabilise.java:61 commitMinimal): sends
+    Commit(COMMIT_SLOW_PATH) so (executeAt, deps) become Committed at a
+    quorum BEFORE the Stable+Read round — recovery then finds a committed
+    status and short-circuits instead of re-deciphering votes.  A
+    strengthening, not a safety requirement: Faults.*_INSTABILITY skips it
+    (CoordinationAdapter.java:172) and the burn must stay correct."""
+
+    def __init__(self, node, txn_id: TxnId, txn: Txn, route: Route,
+                 execute_at: Timestamp, deps: Deps, on_stabilised, on_failed):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.execute_at = execute_at
+        self.deps = deps
+        self._on_stabilised = on_stabilised
+        self._on_failed = on_failed
+        self.tracker: Optional[QuorumTracker] = None
+        self.done = False
+
+    @classmethod
+    def then(cls, node, txn_id: TxnId, txn: Txn, route: Route,
+             execute_at: Timestamp, deps: Deps, proceed, on_failed) -> None:
+        """Run the stabilise round then `proceed()` — or skip straight to
+        `proceed()` under the matching instability fault."""
+        from accord_tpu.utils.faults import FAULTS
+        if FAULTS.instability(txn_id.kind):
+            proceed()
+            return
+        cls(node, txn_id, txn, route, execute_at, deps, proceed,
+            on_failed).start()
+
+    def start(self) -> None:
+        def ready():
+            topologies = self.node.topology.with_unsynced_epochs(
+                self.route.participants(), self.txn_id.epoch,
+                self.execute_at.epoch)
+            self.tracker = QuorumTracker(topologies)
+            for to in topologies.nodes():
+                scope = TxnRequest.compute_scope(to, topologies, self.route)
+                if scope is None:
+                    continue
+                partial = self.txn.slice(scope.covering(),
+                                         include_query=False)
+                self.node.send(
+                    to, Commit(CommitKind.COMMIT_SLOW_PATH, self.txn_id,
+                               scope, partial, self.execute_at, self.deps,
+                               full_route=self.route),
+                    callback=self)
+
+        self.node.with_epoch(self.execute_at.epoch, ready)
+
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        from accord_tpu.messages.base import SimpleReply
+        if isinstance(reply, SimpleReply) and reply.outcome == SimpleReply.NACK:
+            self.done = True
+            self._on_failed(Preempted(
+                f"{self.txn_id} commit nacked by {from_id}"))
+            return
+        if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
+            self.done = True
+            self._on_stabilised()
 
     def on_failure(self, from_id: int, failure: BaseException) -> None:
         if self.done:

@@ -153,7 +153,13 @@ class Recover(Callback):
         def accepted(stable_deps: Deps):
             if self.done:
                 return
-            self._execute(merged, execute_at, stable_deps, txn=txn)
+            from accord_tpu.coordinate.execute import Stabilise
+            Stabilise.then(
+                self.node, self.txn_id, txn, self.route, execute_at,
+                stable_deps,
+                lambda: self._execute(merged, execute_at, stable_deps,
+                                      txn=txn),
+                self._fail)
 
         Propose(self.node, self.txn_id, txn, self.route, self.ballot,
                 execute_at, deps, accepted, self._fail).start()

@@ -112,10 +112,20 @@ class CoordinateTransaction(Callback):
             self.node.events.on_slow_path_taken(self.txn_id)
             Propose(self.node, self.txn_id, self.txn, self.route, Ballot.ZERO,
                     max_witnessed, merged_deps,
-                    lambda stable_deps: self._execute(
-                        CommitKind.STABLE_SLOW_PATH, max_witnessed,
-                        stable_deps),
+                    lambda stable_deps: self._stabilise_then_execute(
+                        max_witnessed, stable_deps),
                     self._fail).start()
+
+    def _stabilise_then_execute(self, execute_at: Timestamp, deps: Deps
+                                ) -> None:
+        """Slow-path tail: commit round (skipped under the instability
+        fault), then Stable+Read (CoordinationAdapter stabilise/execute)."""
+        from accord_tpu.coordinate.execute import Stabilise
+        Stabilise.then(self.node, self.txn_id, self.txn, self.route,
+                       execute_at, deps,
+                       lambda: self._execute(CommitKind.STABLE_SLOW_PATH,
+                                             execute_at, deps),
+                       self._fail)
 
     # ----------------------------------------------------- execute (stable) --
     def _execute(self, kind: CommitKind, execute_at: Timestamp, deps: Deps

@@ -130,6 +130,14 @@ class SimpleProgressLog(ProgressLog):
             self._check_home(state, now)
         for state in list(self.blocked.values()):
             self._check_blocked(state, now)
+        if self.store.gated:
+            # renew per-key execution-gate chases (a gate's first blocker
+            # may have resolved with others remaining) — commands.py
+            from accord_tpu.local.commands import sweep_key_gates
+            from accord_tpu.local.store import PreLoadContext
+            self.store.execute(
+                PreLoadContext.empty(),
+                lambda safe: sweep_key_gates(safe))
 
     def _check_home(self, state: _HomeState, now: float) -> None:
         if state.investigating:

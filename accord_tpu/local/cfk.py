@@ -32,6 +32,7 @@ accord_tpu.ops.deps_kernel and must stay bit-identical to this path.
 from __future__ import annotations
 
 import enum
+import heapq
 from bisect import bisect_left, bisect_right, insort
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -113,9 +114,17 @@ class TxnInfo:
 
 
 class Unmanaged:
-    """A pending notification for a range/sync-point txn waiting on this key
-    (CommandsForKey.Unmanaged, :140-184): fire when every cross-key dep at this
-    key with executeAt <= `waiting_until` reaches COMMIT or APPLY."""
+    """A pending notification for a txn waiting on this key
+    (CommandsForKey.Unmanaged, :140-184): fire when every entry at this key
+    ordered before `waiting_until` reaches COMMIT or APPLY.  Used both for
+    range/sync-point txns and for the key dimension of WaitingOn (the
+    reference's bitset spans txnIds AND keys, Command.java:1294-1643): a
+    Stable key txn holds a key bit until the CFK certifies every
+    earlier-executing entry applied.
+
+    Callbacks take the live SafeCommandStore: the CFK itself is a pure data
+    structure, so `update`/`prune_redundant` RETURN the fired registrations
+    and the calling store context invokes them."""
 
     __slots__ = ("txn_id", "pending", "waiting_until", "callback")
 
@@ -123,7 +132,7 @@ class Unmanaged:
     APPLY = "APPLY"
 
     def __init__(self, txn_id: TxnId, pending: str, waiting_until: Timestamp,
-                 callback: Callable[[], None]):
+                 callback: Callable[["object"], None]):
         self.txn_id = txn_id
         self.pending = pending
         self.waiting_until = waiting_until
@@ -144,8 +153,8 @@ class CommandsForKey:
     missing[] divergence encoding and a committed-by-executeAt view."""
 
     __slots__ = ("key", "_ids", "_status", "_eat", "_missing", "_committed",
-                 "_unmanaged", "redundant_before", "version", "last_mutator",
-                 "committed_version")
+                 "redundant_before", "version", "last_mutator",
+                 "committed_version", "_block_heap", "_wait_heap", "_wait_seq")
 
     def __init__(self, key: Key):
         self.key = key
@@ -155,7 +164,12 @@ class CommandsForKey:
         self._missing: List[Tuple[TxnId, ...]] = []
         # (executeAt, txn_id) sorted, for entries COMMITTED..APPLIED
         self._committed: List[Tuple[Timestamp, TxnId]] = []
-        self._unmanaged: List[Unmanaged] = []
+        # lazy min-heap of (block_point, txn_id) over non-terminal entries —
+        # see _block_point; stale items are dropped at query time
+        self._block_heap: List[Tuple[Timestamp, TxnId]] = []
+        # APPLY-pending registrations as (waiting_until, seq, Unmanaged)
+        self._wait_heap: List[Tuple[Timestamp, int, Unmanaged]] = []
+        self._wait_seq = 0
         self.redundant_before: Optional[TxnId] = None
         # bumped on every mutation; device-store snapshots validate against it.
         # last_mutator = the txn of the latest update(), letting a snapshot
@@ -193,21 +207,25 @@ class CommandsForKey:
     # -------------------------------------------------------- maintenance --
     def update(self, txn_id: TxnId, status: InternalStatus,
                execute_at: Optional[Timestamp] = None,
-               dep_ids: Optional[Sequence[TxnId]] = None) -> None:
+               dep_ids: Optional[Sequence[TxnId]] = None
+               ) -> List["Unmanaged"]:
         """Incremental maintenance on a command transition
         (CommandsForKey.update, :652-770 + the insert/update helpers).
 
         `dep_ids` — the command's key-domain dependency TxnIds AT THIS KEY
         (from its partial/stable deps), required to compute the missing[]
         divergence when `status.has_info`; ignored otherwise.
+
+        Returns newly-satisfied Unmanaged registrations; the caller must
+        invoke their callbacks with its SafeCommandStore.
         """
         pos = self._pos(txn_id)
         if pos >= 0:
             cur = self._status[pos]
             if status < cur:
-                return  # per-key view is monotone
+                return []  # per-key view is monotone
             if status == cur and not status.has_info:
-                return
+                return []
             self.version += 1
             self.last_mutator = txn_id
             was_committed = cur.is_committed
@@ -231,6 +249,7 @@ class CommandsForKey:
             if status.is_decided and not (cur.is_decided):
                 # newly Committed-or-higher: elide from all missing[]
                 self._remove_missing(txn_id)
+            self._push_block_point(self._pos(txn_id))
         else:
             self.version += 1
             self.last_mutator = txn_id
@@ -243,7 +262,8 @@ class CommandsForKey:
             self._apply_deps(txn_id, status, dep_ids)
 
         if status.is_committed or status == InternalStatus.INVALID_OR_TRUNCATED:
-            self._notify_unmanaged()
+            return self._notify_unmanaged()
+        return []
 
     def _insert(self, i: int, txn_id: TxnId, status: InternalStatus,
                 execute_at: Optional[Timestamp]) -> None:
@@ -252,6 +272,7 @@ class CommandsForKey:
         self._eat.insert(i, None if execute_at is None or execute_at == txn_id
                          else execute_at)
         self._missing.insert(i, ())
+        self._push_block_point(i)
         if not status.is_decided:
             # every existing entry with known deps whose bound should have
             # witnessed this id did not (it was unknown until now): record
@@ -312,25 +333,28 @@ class CommandsForKey:
         if self._pos(txn_id) < 0:
             self.update(txn_id, InternalStatus.HISTORICAL)
 
-    def prune_redundant(self, before: TxnId) -> None:
-        """Drop applied/invalidated txns below the redundancy watermark."""
+    def prune_redundant(self, before: TxnId) -> List["Unmanaged"]:
+        """Drop applied/invalidated txns below the redundancy watermark.
+        Returns newly-satisfied Unmanaged registrations (the watermark can
+        raise the min block point); caller dispatches the callbacks."""
         self.version += 1
         self.last_mutator = None
         self.redundant_before = (before if self.redundant_before is None
                                  else max(self.redundant_before, before))
         drop = [i for i, t in enumerate(self._ids)
                 if t < before and self._status[i].is_terminal]
-        if not drop:
-            return
-        dropped = {self._ids[i] for i in drop}
-        for i in reversed(drop):
-            if self._status[i].is_committed:
-                self._committed_remove(self._ids[i], self._eat_of(i))
-            del self._ids[i], self._status[i], self._eat[i], self._missing[i]
-        for j in range(len(self._missing)):
-            m = self._missing[j]
-            if m and any(t in dropped for t in m):
-                self._missing[j] = tuple(t for t in m if t not in dropped)
+        if drop:
+            dropped = {self._ids[i] for i in drop}
+            for i in reversed(drop):
+                if self._status[i].is_committed:
+                    self._committed_remove(self._ids[i], self._eat_of(i))
+                del self._ids[i], self._status[i], self._eat[i], \
+                    self._missing[i]
+            for j in range(len(self._missing)):
+                m = self._missing[j]
+                if m and any(t in dropped for t in m):
+                    self._missing[j] = tuple(t for t in m if t not in dropped)
+        return self._notify_unmanaged()
 
     # ------------------------------------------------------ introspection --
     def get(self, txn_id: TxnId) -> Optional[TxnInfo]:
@@ -510,40 +534,105 @@ class CommandsForKey:
         return out
 
     # ---------------------------------------- unmanaged (cross-key) waits --
+    #
+    # Efficiency: an entry's *block point* — the lowest waiting_until it can
+    # block — is its id while undecided, its executeAt while committed, and
+    # gone once terminal/invisible/redundant.  Transitions only ever RAISE
+    # it, so a lazy min-heap over block points plus a min-heap of
+    # registrations by waiting_until makes each update O(log n) amortised:
+    # a registration fires exactly when min-block-point >= its
+    # waiting_until.  (A notify-all-per-update formulation is quadratic on
+    # a deep same-key chain — 3000 committed writes at one key wedged the
+    # burn for minutes.)
+
     def register_unmanaged(self, unmanaged: Unmanaged) -> None:
-        self._unmanaged.append(unmanaged)
-        self._notify_unmanaged()
+        """Record an APPLY wait.  Caller contract: register only after
+        proving blockers exist (commands._initialise_key_wait does) — the
+        satisfaction check is the caller's, so no walk happens here."""
+        invariants.check_state(unmanaged.pending == Unmanaged.APPLY,
+                               "only APPLY waits are registrable; COMMIT is "
+                               "a query mode (blocking_ids)")
+        self._wait_seq += 1
+        heapq.heappush(self._wait_heap,
+                       (unmanaged.waiting_until, self._wait_seq, unmanaged))
 
-    def _notify_unmanaged(self) -> None:
-        if not self._unmanaged:
-            return
-        fire: List[Unmanaged] = []
-        keep: List[Unmanaged] = []
-        for u in self._unmanaged:
-            if self._unmanaged_satisfied(u):
-                fire.append(u)
-            else:
-                keep.append(u)
-        self._unmanaged = keep
-        for u in fire:
-            u.callback()
+    def has_unmanaged(self, txn_id: TxnId) -> bool:
+        return any(w[2].txn_id == txn_id for w in self._wait_heap)
 
-    def _unmanaged_satisfied(self, u: Unmanaged) -> bool:
+    def _block_point(self, i: int) -> Optional[Timestamp]:
+        st = self._status[i]
+        t = self._ids[i]
+        if st.is_terminal or st == InternalStatus.TRANSITIVELY_KNOWN \
+                or not t.is_visible:
+            return None
+        if self.redundant_before is not None and t < self.redundant_before:
+            return None
+        return self._eat_of(i) if st.is_committed else t
+
+    def _push_block_point(self, i: int) -> None:
+        bp = self._block_point(i)
+        if bp is not None:
+            heapq.heappush(self._block_heap, (bp, self._ids[i]))
+
+    def _min_block_point(self) -> Optional[Timestamp]:
+        """Current minimum block point (None = nothing blocks).  Stale heap
+        items — transitions pushed fresh copies — are popped lazily."""
+        while self._block_heap:
+            bp, t = self._block_heap[0]
+            i = self._pos(t)
+            cur = self._block_point(i) if i >= 0 else None
+            if cur is not None and cur == bp:
+                return bp
+            heapq.heappop(self._block_heap)
+            if cur is not None:
+                # moved (committed: id -> executeAt); reinsert at the new point
+                heapq.heappush(self._block_heap, (cur, t))
+        return None
+
+    def _notify_unmanaged(self) -> List[Unmanaged]:
+        fired: List[Unmanaged] = []
+        if self._wait_heap:
+            mbp = self._min_block_point()
+            while self._wait_heap and (mbp is None
+                                       or self._wait_heap[0][0] <= mbp):
+                fired.append(heapq.heappop(self._wait_heap)[2])
+        return fired
+
+    def blocking_ids(self, pending: str, waiting_until: Timestamp,
+                     exclude: Optional[TxnId] = None,
+                     first_only: bool = False,
+                     skip_pred: Optional[Callable[[TxnId], bool]] = None
+                     ) -> List[Tuple[TxnId, bool]]:
+        """Entries currently failing the wait rule: for APPLY, every visible
+        entry ordered before `waiting_until` must be terminal or committed
+        with executeAt after it; for COMMIT, merely decided.  Returns
+        (txn_id, is_decided) pairs — the progress log chases undecided
+        blockers to Committed and decided ones to Applied.  Entries below
+        the redundancy watermark (or matching `skip_pred`, e.g. the
+        per-store RedundantBefore) are already reflected in local state
+        (snapshot or GC) and never block."""
+        out: List[Tuple[TxnId, bool]] = []
         for i, t in enumerate(self._ids):
-            if t >= u.waiting_until or t == u.txn_id:
+            if t >= waiting_until or t == exclude:
+                continue
+            if self.redundant_before is not None and t < self.redundant_before:
                 continue
             st = self._status[i]
             if not t.is_visible or st == InternalStatus.TRANSITIVELY_KNOWN:
                 continue
-            if u.pending == Unmanaged.COMMIT:
+            if pending == Unmanaged.COMMIT:
                 if not st.is_decided:
-                    return False
+                    out.append((t, False))
             else:  # APPLY
                 if not st.is_terminal:
                     if not (st.is_committed
-                            and self._eat_of(i) > u.waiting_until):
-                        return False
-        return True
+                            and self._eat_of(i) > waiting_until):
+                        out.append((t, st.is_decided))
+            if out and skip_pred is not None and skip_pred(out[-1][0]):
+                out.pop()
+            if out and first_only:
+                return out
+        return out
 
     def __repr__(self):
         return f"CFK({self.key!r}, {len(self._ids)} txns)"

@@ -121,5 +121,6 @@ def sweep(store) -> int:
     for key, cfk in store.cfks.items():
         bound = store.redundant_before.shard_applied_before(key)
         if bound.hlc > 0:
-            cfk.prune_redundant(bound)
+            for u in cfk.prune_redundant(bound):
+                u.callback(safe)
     return purged

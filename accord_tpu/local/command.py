@@ -25,27 +25,57 @@ from accord_tpu.utils.sorted_arrays import find_ceil
 
 
 class WaitingOn:
-    """Bitset over the stable deps this command must see applied before it can
-    execute (Command.java:1294-1643).
+    """Bitsets over the stable deps AND the participating keys this command
+    must see cleared before it can execute (Command.java:1294-1643 — the
+    reference bitset spans txnIds ∪ keys).
 
     A dep blocks until it is (a) committed with executeAt AFTER ours — then it
     is ordered after us and removed; or (b) applied / invalidated / truncated.
+    A key blocks until the CommandsForKey certifies every earlier-executing
+    entry at that key applied (the per-key execution gate that holds even for
+    conflicts the deps happen to omit).
     """
 
-    __slots__ = ("txn_ids", "waiting", "applied_or_invalidated")
+    __slots__ = ("txn_ids", "waiting", "applied_or_invalidated", "keys",
+                 "waiting_keys")
 
-    def __init__(self, txn_ids: Tuple[TxnId, ...]):
+    def __init__(self, txn_ids: Tuple[TxnId, ...], keys: Tuple = ()):
         self.txn_ids = txn_ids
         self.waiting = SimpleBitSet.full(len(txn_ids)) if txn_ids else SimpleBitSet(0)
         self.applied_or_invalidated = SimpleBitSet(len(txn_ids))
+        self.keys = keys
+        self.waiting_keys = (SimpleBitSet.full(len(keys)) if keys
+                             else SimpleBitSet(0))
 
     @classmethod
-    def from_deps(cls, deps: Deps) -> "WaitingOn":
-        return cls(tuple(deps.sorted_txn_ids()))
+    def from_deps(cls, deps: Deps, keys: Tuple = ()) -> "WaitingOn":
+        return cls(tuple(deps.sorted_txn_ids()), keys)
 
     @property
     def is_waiting(self) -> bool:
-        return not self.waiting.is_empty()
+        return not self.waiting.is_empty() \
+            or not self.waiting_keys.is_empty()
+
+    @property
+    def is_waiting_on_key(self) -> bool:
+        return not self.waiting_keys.is_empty()
+
+    def key_index_of(self, key) -> int:
+        try:
+            return self.keys.index(key)
+        except ValueError:
+            return -1
+
+    def is_waiting_on_key_at(self, key) -> bool:
+        i = self.key_index_of(key)
+        return i >= 0 and self.waiting_keys.get(i)
+
+    def remove_waiting_on_key(self, key) -> bool:
+        i = self.key_index_of(key)
+        return i >= 0 and self.waiting_keys.unset(i)
+
+    def waiting_key_list(self):
+        return [self.keys[i] for i in self.waiting_keys]
 
     def index_of(self, txn_id: TxnId) -> int:
         i = find_ceil(self.txn_ids, txn_id)
@@ -77,7 +107,9 @@ class WaitingOn:
         return [self.txn_ids[i] for i in self.waiting]
 
     def __repr__(self):
-        return f"WaitingOn({self.waiting_ids()!r})"
+        return (f"WaitingOn({self.waiting_ids()!r}"
+                + (f", keys={self.waiting_key_list()!r}"
+                   if self.is_waiting_on_key else "") + ")")
 
 
 class TransientListener:

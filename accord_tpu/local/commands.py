@@ -385,15 +385,139 @@ def apply(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
 # -------------------------------------------------- execution ordering core --
 
 def initialise_waiting_on(safe_store: SafeCommandStore, cmd: Command) -> None:
-    """Build the WaitingOn bitset over stable deps owned by this store and
-    register as listener on each still-blocking dep
-    (Commands.initialiseWaitingOn :735 + updateWaitingOn :776)."""
+    """Build the WaitingOn bitsets — over stable deps owned by this store AND
+    over the command's own keys — and register as listener on each
+    still-blocking dep (Commands.initialiseWaitingOn :735 + updateWaitingOn
+    :776; the key dimension is the reference's txnIds ∪ keys bitset,
+    Command.java:1425-1436, cleared per key by CommandsForKey)."""
     deps = cmd.stable_deps if cmd.stable_deps is not None else Deps.NONE
     local = deps.slice(safe_store.ranges) if not safe_store.ranges.is_empty else deps
-    waiting_on = WaitingOn.from_deps(local)
+    gate_keys = ()
+    if cmd.txn_id.is_key_domain and cmd.txn_id.kind.is_globally_visible \
+            and cmd.execute_at is not None:
+        gate_keys = tuple(safe_store.owned_keys_of(cmd))
+    waiting_on = WaitingOn.from_deps(local, keys=gate_keys)
     cmd.waiting_on = waiting_on
     for dep_id in list(waiting_on.txn_ids):
         _update_waiting_on_dep(safe_store, cmd, dep_id)
+    for key in gate_keys:
+        _initialise_key_wait(safe_store, cmd, key)
+
+
+def _initialise_key_wait(safe_store: SafeCommandStore, cmd: Command,
+                         key) -> None:
+    """Arm the per-key execution gate: the key bit holds until the CFK
+    certifies every earlier-executing entry applied.  Even a conflict the
+    stable deps omit (e.g. under the unmerged-deps fault, or a commit that
+    raced the accept round) cannot be overtaken: it lives in the CFK of some
+    common replica and blocks there (the reference clears these bits via
+    CommandsForKey.update -> removeWaitingOnKeyAndMaybeExecute,
+    Commands.java:859)."""
+    from accord_tpu.local.cfk import Unmanaged
+    cfk = safe_store.cfk(key)
+    blockers = _key_gate_blockers(safe_store, cfk, cmd, key)
+    if not blockers:
+        cmd.waiting_on.remove_waiting_on_key(key)
+        return
+    txn_id = cmd.txn_id
+
+    def fired(ss, _key=key, _txn_id=txn_id):
+        _enqueue_notify(ss, ("key_unblock", _txn_id, _key))
+
+    cfk.register_unmanaged(
+        Unmanaged(txn_id, Unmanaged.APPLY, cmd.execute_at, fired))
+    safe_store.store.gated.setdefault(txn_id, set()).add(key)
+    _chase_key_blocker(safe_store, cmd, blockers)
+
+
+def _chase_key_blocker(safe_store: SafeCommandStore, cmd: Command,
+                       blockers) -> None:
+    """Chase the gate's CURRENT first blocker (the progress log drives it to
+    Committed/Applied).  The chase is renewed each progress-log sweep
+    (sweep_key_gates) so a multi-blocker gate keeps being driven after its
+    first blocker resolves — a per-transition hand-over would fan out to
+    every waiter of a hot key and go quadratic."""
+    blocking_id, decided = blockers[0]
+    safe_store.progress_log.waiting(
+        blocking_id, safe_store.store,
+        "Applied" if decided else "Committed", None,
+        cmd.route.participants() if cmd.route else None)
+
+
+def sweep_key_gates(safe_store: SafeCommandStore) -> None:
+    """Periodic liveness pass over armed key gates (called from the progress
+    log's recurring run): re-chase each gate's current first blocker, clear
+    gates whose blockers are all gone (e.g. covered by an advanced
+    redundancy watermark with no CFK transition to fire the heap)."""
+    store = safe_store.store
+    for txn_id in list(store.gated):
+        cmd = store.commands.get(txn_id)
+        waiting_on = cmd.waiting_on if cmd is not None else None
+        # snapshot: the drain triggered by _enqueue_notify below removes
+        # cleared keys from the live store.gated set
+        keys = list(store.gated.get(txn_id, ()))
+        live = set()
+        for key in keys:
+            if waiting_on is None or not waiting_on.is_waiting_on_key_at(key):
+                continue
+            blockers = _key_gate_blockers(safe_store, safe_store.cfk(key),
+                                          cmd, key)
+            if blockers:
+                live.add(key)
+                _chase_key_blocker(safe_store, cmd, blockers)
+            else:
+                _enqueue_notify(safe_store, ("key_unblock", txn_id, key))
+        if live:
+            store.gated[txn_id] = live
+        elif not store.gated.get(txn_id):
+            store.gated.pop(txn_id, None)
+
+
+def _key_gate_blockers(safe_store: SafeCommandStore, cfk, cmd: Command,
+                       key):
+    """The CFK's APPLY-rule blockers minus entries the redundancy watermark
+    already covers (pre-bootstrap / GC'd — mirrors _is_redundant_dep)."""
+    from accord_tpu.local.cfk import Unmanaged
+    # Fast path: the CFK's min block point (lazy heap, O(log) amortised)
+    # proves the gate clear without walking entries — our own entry cannot
+    # be the sub-threshold min (its block point IS our executeAt).  The
+    # exact walk runs only when genuinely blocked, to name a blocker to
+    # chase and to apply per-store redundancy the CFK can't see.
+    mbp = cfk._min_block_point()
+    if mbp is None or mbp >= cmd.execute_at:
+        return []
+    rb = safe_store.store.redundant_before
+    return cfk.blocking_ids(
+        Unmanaged.APPLY, cmd.execute_at, cmd.txn_id, first_only=True,
+        skip_pred=lambda t: rb.is_redundant(t, key))
+
+
+def _recheck_key_gate(safe_store: SafeCommandStore, txn_id: TxnId,
+                      key) -> None:
+    """CFK notification: the key's wait rule may have cleared."""
+    cmd = safe_store.if_present(txn_id)
+    if cmd is None or cmd.waiting_on is None \
+            or not cmd.waiting_on.is_waiting_on_key_at(key):
+        return
+    cfk = safe_store.cfk(key)
+    blockers = _key_gate_blockers(safe_store, cfk, cmd, key)
+    if blockers:
+        # still blocked (e.g. a redundancy-aware recheck or a blocker
+        # hand-over): re-arm the CFK registration if a fire consumed it,
+        # and move the chase onto the current first blocker
+        if not cfk.has_unmanaged(cmd.txn_id):
+            _initialise_key_wait(safe_store, cmd, key)
+        else:
+            _chase_key_blocker(safe_store, cmd, blockers)
+        return
+    if cmd.waiting_on.remove_waiting_on_key(key):
+        gated = safe_store.store.gated
+        keys = gated.get(txn_id)
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                gated.pop(txn_id, None)
+        maybe_execute(safe_store, cmd, always_notify=False)
 
 
 def _update_waiting_on_dep(safe_store: SafeCommandStore, cmd: Command,
@@ -500,6 +624,10 @@ def re_evaluate_waiting(safe_store: SafeCommandStore) -> None:
         if waiting_on is not None and waiting_on.is_waiting:
             for dep_id in waiting_on.waiting_ids():
                 _update_waiting_on_dep(safe_store, cmd, dep_id)
+            for key in waiting_on.waiting_key_list():
+                # advanced watermarks can satisfy a key gate without any CFK
+                # transition (snapshot covers the blockers) — recheck
+                _enqueue_notify(safe_store, ("key_unblock", cmd.txn_id, key))
         if cmd.save_status in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED) \
                 and (waiting_on is None or not waiting_on.is_waiting):
             # includes applies that were deferred on un-bootstrapped ranges
@@ -585,19 +713,29 @@ def _apply_writes(safe_store: SafeCommandStore, cmd: Command) -> None:
 
 def _notify_listeners(safe_store: SafeCommandStore, cmd: Command) -> None:
     """Notify durable (dependent commands) and transient listeners of a
-    transition. Re-entrant calls enqueue onto the store-level drain queue so
-    arbitrarily deep apply cascades use constant stack (the reference's
-    NotifyWaitingOn walker, Commands.java:1011, achieves the same by running
-    each step as a separate executor task)."""
+    transition (see _enqueue_notify for the constant-stack drain)."""
+    _enqueue_notify(safe_store, cmd.txn_id)
+
+
+def _enqueue_notify(safe_store: SafeCommandStore, item) -> None:
+    """Enqueue a notification and drain unless already draining. Items are
+    either a TxnId (notify its listeners) or ("key_unblock", txn_id, key)
+    (re-check a key gate).  Re-entrant calls enqueue onto the store-level
+    drain queue so arbitrarily deep apply cascades use constant stack (the
+    reference's NotifyWaitingOn walker, Commands.java:1011, achieves the
+    same by running each step as a separate executor task)."""
     store = safe_store.store
-    store.notify_queue.append(cmd.txn_id)
+    store.notify_queue.append(item)
     if store.notifying:
         return
     store.notifying = True
     try:
         while store.notify_queue:
-            tid = store.notify_queue.popleft()
-            c = store.commands.get(tid)
+            entry = store.notify_queue.popleft()
+            if isinstance(entry, tuple) and entry[0] == "key_unblock":
+                _recheck_key_gate(safe_store, entry[1], entry[2])
+                continue
+            c = store.commands.get(entry)
             if c is None:
                 continue
             for listener in list(c.transient_listeners):

@@ -152,8 +152,10 @@ class SafeCommandStore:
         for key in self.owned_keys_of(command):
             dep_ids = deps.key_deps.txn_ids_for_key(key) \
                 if deps is not None else None
-            self.cfk(key).update(command.txn_id, status, command.execute_at,
-                                 dep_ids=dep_ids)
+            fired = self.cfk(key).update(command.txn_id, status,
+                                         command.execute_at, dep_ids=dep_ids)
+            for u in fired:
+                u.callback(self)
 
     def register_range_txn(self, command: Command, ranges: Ranges) -> None:
         self.store.range_commands[command.txn_id] = ranges.slice(self.ranges) \
@@ -364,6 +366,9 @@ class CommandStore:
         # listener-notification drain queue (see commands._notify_listeners)
         from collections import deque
         self.notify_queue = deque()
+        # txn_id -> keys with an armed per-key execution gate; swept by the
+        # progress log (commands.sweep_key_gates) to keep chasing blockers
+        self.gated: Dict[TxnId, set] = {}
         self.notifying = False
         # per-txn count of failed catch-ups where every peer had truncated
         # the deps (Propagate INSUFFICIENT): drives staleness escalation

@@ -126,14 +126,16 @@ class TestFaults:
         cluster = SimCluster(n_nodes=3, seed=10)
         cluster.network.default_link = LinkConfig(deliver_prob=0.85)
         # with retries-by-timeout not yet implemented, individual txns may
-        # time out; commit enough and require a clear majority to succeed
+        # time out; commit enough and require a solid fraction to succeed
+        # (the slow path is 4 rounds with the Stabilise commit round, so
+        # per-txn survival under 15% loss is lower than a lossless run)
         ok = 0
-        for i in range(10):
+        for i in range(20):
             result = cluster.node(1 + i % 3).coordinate(rw_txn([], {4: i}))
             cluster.process_until(lambda: result.is_done)
             if result.is_done and result.is_success:
                 ok += 1
-        assert ok >= 5
+        assert ok >= 8
 
     def test_minority_partition_cannot_commit(self):
         cluster = SimCluster(n_nodes=5, seed=11, rf=5)

@@ -1,0 +1,103 @@
+"""Fault-injection flags (reference accord/utils/Faults.java).
+
+Every flag disables a protocol STRENGTHENING, not a safety requirement:
+skipping the Stabilise commit round (CoordinationAdapter.java:172) or
+proposing pre-accept deps without the accept-round recalculations
+(ProposeTxn.java:48, ProposeSyncPoint.java:55) must leave the burn
+strict-serializable — recovery and the Accept round's own coverage carry
+the safety argument.
+"""
+
+import pytest
+
+from accord_tpu.coordinate.syncpoint import CoordinateSyncPoint, SyncPoint
+from accord_tpu.impl.list_store import ListQuery, ListUpdate
+from accord_tpu.messages.commit import Commit, CommitKind
+from accord_tpu.primitives.keys import Key, Keys, Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.burn import BurnRun
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.utils.faults import FAULTS, Faults, injected
+
+
+def write_txn(appends: dict):
+    return Txn(TxnKind.WRITE, Keys.of(*appends), query=ListQuery(),
+               update=ListUpdate({Key(t): v for t, v in appends.items()}))
+
+
+def run(cluster, result):
+    ok = cluster.process_until(lambda: result.is_done)
+    assert ok, "did not complete"
+    if result.failure() is not None:
+        raise result.failure()
+    return result.value()
+
+
+def count_commit_slow_path(cluster, counted):
+    """Install a pass-through filter tallying COMMIT_SLOW_PATH sends."""
+    def spy(from_id, to_id, message):
+        if isinstance(message, Commit) \
+                and message.kind == CommitKind.COMMIT_SLOW_PATH:
+            counted[0] += 1
+        return False  # never drop
+
+    cluster.network.add_filter(spy)
+
+
+class TestStabiliseRound:
+    def test_slow_path_runs_commit_round_by_default(self):
+        """Sync points always take the slow path; the pre-execution commit
+        round (Stabilise.java commitMinimal) must appear on the wire."""
+        counted = [0]
+        cluster = SimCluster(n_nodes=3, seed=61, n_shards=2)
+        count_commit_slow_path(cluster, counted)
+        sp = run(cluster, CoordinateSyncPoint.coordinate(
+            cluster.node(1), TxnKind.SYNC_POINT, Ranges.of((0, 100))))
+        assert isinstance(sp, SyncPoint)
+        assert counted[0] > 0, "stabilise round never hit the wire"
+
+    def test_instability_fault_skips_commit_round(self):
+        counted = [0]
+        cluster = SimCluster(n_nodes=3, seed=62, n_shards=2)
+        count_commit_slow_path(cluster, counted)
+        with injected(syncpoint_instability=True):
+            sp = run(cluster, CoordinateSyncPoint.coordinate(
+                cluster.node(1), TxnKind.SYNC_POINT, Ranges.of((0, 100))))
+        assert isinstance(sp, SyncPoint)
+        assert counted[0] == 0, "fault did not suppress the stabilise round"
+
+    def test_defaults_are_all_off(self):
+        assert not FAULTS.transaction_instability
+        assert not FAULTS.syncpoint_instability
+        assert not FAULTS.transaction_unmerged_deps
+        assert not FAULTS.syncpoint_unmerged_deps
+
+    def test_kind_dispatch(self):
+        f = Faults(transaction_instability=True,
+                   syncpoint_unmerged_deps=True)
+        assert f.instability(TxnKind.WRITE)
+        assert not f.instability(TxnKind.SYNC_POINT)
+        assert f.unmerged_deps(TxnKind.EXCLUSIVE_SYNC_POINT)
+        assert not f.unmerged_deps(TxnKind.READ)
+
+
+class TestBurnUnderFaults:
+    """The burn's strict-serializability verifier is the oracle: each fault
+    (and all four together) must leave history correct."""
+
+    @pytest.mark.parametrize("flag", [
+        "transaction_instability", "syncpoint_instability",
+        "transaction_unmerged_deps", "syncpoint_unmerged_deps"])
+    def test_burn_with_single_fault(self, flag):
+        with injected(**{flag: True}):
+            stats = BurnRun(seed=63, ops=100).run()
+        assert stats.acks > 0
+
+    def test_burn_with_all_faults_and_loss(self):
+        with injected(transaction_instability=True,
+                      syncpoint_instability=True,
+                      transaction_unmerged_deps=True,
+                      syncpoint_unmerged_deps=True):
+            stats = BurnRun(seed=64, ops=120, drop_prob=0.05).run()
+        assert stats.acks > 0

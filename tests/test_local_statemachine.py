@@ -270,6 +270,102 @@ class TestChains:
         assert node.data_store.get(Key(10)) == tuple(range(n))
 
 
+class TestKeyGate:
+    """The per-key execution gate: WaitingOn's key dimension
+    (Command.java:1294-1643 bitsets over txnIds ∪ keys)."""
+
+    def test_gate_blocks_dep_omitted_earlier_conflict(self, env):
+        """A committed write the waiter's deps omit still gates execution at
+        any replica that witnessed it (the unmerged-deps / raced-commit
+        shape)."""
+        node, store, safe = env
+        x_id, x_txn, x_route = write_txn(node, [10], 1)
+        C.preaccept(safe, x_id, x_txn.slice(Ranges.of((0, 1000)), True), x_route)
+        w_id, w_txn, w_route = write_txn(node, [10], 2)
+        C.preaccept(safe, w_id, w_txn.slice(Ranges.of((0, 1000)), True), w_route)
+        # W commits Stable with EMPTY deps (X deliberately omitted)
+        full_commit(safe, w_id, w_txn, w_route)
+        writes = w_txn.execute(w_id, w_id, None)
+        C.apply(safe, w_id, w_route, w_id, Deps.NONE, writes, None)
+        w = safe.get(w_id)
+        assert w.save_status != SaveStatus.APPLIED, \
+            "gate failed: W applied over an undecided earlier conflict"
+        assert w.waiting_on.is_waiting_on_key
+        # X commits and applies -> the gate clears and W cascades
+        full_commit(safe, x_id, x_txn, x_route)
+        x_writes = x_txn.execute(x_id, x_id, None)
+        C.apply(safe, x_id, x_route, x_id, Deps.NONE, x_writes, None)
+        assert safe.get(w_id).save_status == SaveStatus.APPLIED
+        assert node.data_store.get(Key(10)) == (1, 2)  # executeAt order
+
+    def test_gate_sweep_chases_second_blocker(self, env):
+        """Two dep-omitted blockers: when the first resolves with the second
+        still undecided, the sweep re-chases the second (the one-shot-chase
+        wedge found in review)."""
+        node, store, safe = env
+        x_id, x_txn, x_route = write_txn(node, [10], 1)
+        C.preaccept(safe, x_id, x_txn.slice(Ranges.of((0, 1000)), True), x_route)
+        y_id, y_txn, y_route = write_txn(node, [10], 2)
+        C.preaccept(safe, y_id, y_txn.slice(Ranges.of((0, 1000)), True), y_route)
+        w_id, w_txn, w_route = write_txn(node, [10], 3)
+        C.preaccept(safe, w_id, w_txn.slice(Ranges.of((0, 1000)), True), w_route)
+        full_commit(safe, w_id, w_txn, w_route)
+        writes = w_txn.execute(w_id, w_id, None)
+        C.apply(safe, w_id, w_route, w_id, Deps.NONE, writes, None)
+        assert safe.get(w_id).waiting_on.is_waiting_on_key
+        assert w_id in store.gated
+
+        # first blocker X resolves; Y still holds the gate
+        full_commit(safe, x_id, x_txn, x_route)
+        C.apply(safe, x_id, x_route, x_id, Deps.NONE,
+                x_txn.execute(x_id, x_id, None), None)
+        assert safe.get(w_id).waiting_on.is_waiting_on_key
+
+        chased = []
+        orig_waiting = store.progress_log.waiting
+        store.progress_log.waiting = (
+            lambda bid, *a, **kw: chased.append(bid))
+        try:
+            C.sweep_key_gates(safe)
+        finally:
+            store.progress_log.waiting = orig_waiting
+        assert y_id in chased, "sweep did not re-chase the second blocker"
+
+        # Y resolves -> gate clears, W applies, executeAt order holds
+        full_commit(safe, y_id, y_txn, y_route)
+        C.apply(safe, y_id, y_route, y_id, Deps.NONE,
+                y_txn.execute(y_id, y_id, None), None)
+        assert safe.get(w_id).save_status == SaveStatus.APPLIED
+        assert w_id not in store.gated or not store.gated[w_id]
+        assert node.data_store.get(Key(10)) == (1, 2, 3)
+
+
+    def test_gate_sweep_clears_redundancy_covered_blocker(self, env):
+        """A gate whose only blocker becomes redundant (snapshot/GC fence)
+        with no CFK transition must be cleared by the sweep — and the sweep
+        must survive the synchronous drain mutating store.gated while it
+        iterates (crashed with 'Set changed size during iteration')."""
+        node, store, safe = env
+        x_id, x_txn, x_route = write_txn(node, [10], 1)
+        C.preaccept(safe, x_id, x_txn.slice(Ranges.of((0, 1000)), True), x_route)
+        w_id, w_txn, w_route = write_txn(node, [10], 2)
+        C.preaccept(safe, w_id, w_txn.slice(Ranges.of((0, 1000)), True), w_route)
+        full_commit(safe, w_id, w_txn, w_route)
+        C.apply(safe, w_id, w_route, w_id, Deps.NONE,
+                w_txn.execute(w_id, w_id, None), None)
+        assert w_id in store.gated
+
+        rb = store.redundant_before
+        orig = rb.is_redundant
+        rb.is_redundant = lambda t, key: t == x_id or orig(t, key)
+        try:
+            C.sweep_key_gates(safe)
+        finally:
+            rb.is_redundant = orig
+        assert safe.get(w_id).save_status == SaveStatus.APPLIED
+        assert w_id not in store.gated
+
+
 class TestDurabilityAndTruncation:
     def test_set_durability_and_purge(self, env):
         node, store, safe = env
