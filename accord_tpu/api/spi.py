@@ -149,13 +149,23 @@ class DataStore(abc.ABC):
     (reference api/DataStore.java:39-113)."""
 
     class FetchResult(AsyncResult):
-        """AsyncResult[Ranges] of successfully fetched ranges; abort() cancels."""
+        """AsyncResult[Ranges] of successfully fetched ranges;
+        abort(ranges) asks the implementation to stop fetching ranges that
+        stopped mattering (DataStore.FetchResult, DataStore.java:103-113)."""
 
-        def abort(self) -> None:
-            pass
+        abort_hook = None  # set by the driving coordinator
+
+        def abort(self, ranges: "Ranges") -> None:
+            if self.abort_hook is not None:
+                self.abort_hook(ranges)
 
     class FetchRanges(abc.ABC):
-        """Callbacks the store invokes as it makes ranges durable locally."""
+        """Callbacks the fetch implementation invokes as ranges progress
+        (DataStore.FetchRanges, DataStore.java:74-99): `starting` when a
+        source is contacted (its token's `started(max_applied)` fires on
+        snapshot confirmation and returns an abort handle), `fetched` as
+        sub-ranges land (repeatable, any subdivision), `fail` when a
+        sub-range exhausted its sources."""
 
         @abc.abstractmethod
         def starting(self, ranges: "Ranges"):
@@ -171,12 +181,13 @@ class DataStore(abc.ABC):
 
     def fetch(self, node, safe_store, ranges: "Ranges", sync_point,
               fetch_ranges: "DataStore.FetchRanges") -> "DataStore.FetchResult":
-        """Copy `ranges` from peers up to `sync_point`; default: nothing to copy
-        (in-memory hosts snapshot via the apply stream)."""
-        result = DataStore.FetchResult()
-        fetch_ranges.fetched(ranges)
-        result.set_success(ranges)
-        return result
+        """Copy `ranges` from peers up to `sync_point` (the bootstrap fence).
+        Default: the generic ranged FetchCoordinator over the FetchSnapshot
+        wire protocol with per-shard source failover — stores with bespoke
+        movement (file streaming, object storage) override."""
+        from accord_tpu.impl.fetch_coordinator import FetchCoordinator
+        return FetchCoordinator(node, ranges, sync_point, fetch_ranges,
+                                self).start().result
 
     # -- snapshot transfer primitives (bootstrap; DataStore.java fetch
     #    implementations move data in host-defined snapshot units) --

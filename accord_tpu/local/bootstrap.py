@@ -10,21 +10,39 @@ below the fence are treated as already-satisfied locally.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Optional
 
+from accord_tpu.api.spi import DataStore
 from accord_tpu.coordinate.syncpoint import CoordinateSyncPoint, SyncPoint
-from accord_tpu.messages.base import Callback
-from accord_tpu.messages.epoch import (FetchSnapshot, FetchSnapshotNack,
-                                       FetchSnapshotOk)
 from accord_tpu.primitives.keys import Ranges
 from accord_tpu.primitives.timestamp import TxnKind
 from accord_tpu.utils.async_chains import AsyncResult
 
 
-class Bootstrap(Callback):
-    """One bootstrap attempt chain for `ranges` (Bootstrap.Attempt). Retries
-    itself (fresh fence) on failure — the reference defers the retry policy
-    to Agent.onFailedBootstrap."""
+class _AttemptFetchRanges(DataStore.FetchRanges):
+    """Bootstrap's view of fetch progress (the FetchRanges callbacks of
+    DataStore.java:74-99): accumulate fetched coverage as sub-ranges land so
+    a later attempt only re-fetches what is still missing."""
+
+    def __init__(self, attempt: "Bootstrap"):
+        self.attempt = attempt
+
+    def starting(self, ranges: Ranges):
+        return None  # the default coordinator manages its own tokens
+
+    def fetched(self, ranges: Ranges) -> None:
+        self.attempt.covered = self.attempt.covered.union(ranges)
+
+    def fail(self, ranges: Ranges, failure: BaseException) -> None:
+        pass  # the attempt-level future failing drives the retry
+
+
+class Bootstrap:
+    """One bootstrap attempt chain for `ranges` (Bootstrap.Attempt): fence,
+    then DataStore.fetch (the ranged FetchCoordinator with per-shard source
+    failover), then the conflict-watermark fence and safe-to-read flip.
+    Retries itself (fresh fence, missing ranges only) on failure — the
+    reference defers the retry policy to Agent.onFailedBootstrap."""
 
     def __init__(self, node, ranges: Ranges, epoch: int,
                  result: Optional[AsyncResult] = None):
@@ -35,8 +53,8 @@ class Bootstrap(Callback):
         self.result = result if result is not None else AsyncResult()
         self.sp: Optional[SyncPoint] = None
         self.covered = Ranges.EMPTY
-        self.pending: Dict[int, Ranges] = {}
-        self.tried: set = set()
+        self.fetch_result: Optional[DataStore.FetchResult] = None
+        self.max_applied = None
         self.done = False
 
     def start(self) -> "Bootstrap":
@@ -54,112 +72,87 @@ class Bootstrap(Callback):
                               self.epoch, self.result).start()
             if not self.result.is_done else None)
 
+    def abort(self, ranges: Ranges) -> None:
+        """The ranges moved away under a newer topology: stop fetching them
+        (FetchResult.abort passthrough)."""
+        if self.fetch_result is not None:
+            self.fetch_result.abort(ranges)
+
     # ------------------------------------------------------------- fence --
     def _on_fence(self, sp: Optional[SyncPoint], failure) -> None:
         if failure is not None:
             self._retry()
             return
         self.sp = sp
-        self._fetch_missing()
+        self.fetch_result = self.node.data_store.fetch(
+            self.node, None, self.ranges.subtract(self.covered), sp,
+            _AttemptFetchRanges(self))
+        self.fetch_result.add_callback(self._on_fetched)
 
-    def _fetch_missing(self) -> None:
-        missing = self.ranges.subtract(self.covered)
-        if missing.is_empty:
-            self._finish()
-            return
-        # one source per shard: any current replica other than ourselves has
-        # the full sub-range once the fence applied there
-        topology = self.node.topology.for_epoch(self.epoch)
-        requested = False
-        sources_exist = False
-        for shard in topology.for_selection(missing).shards:
-            want = Ranges([shard.range]).slice(missing)
-            if want.is_empty:
-                continue
-            if any(n != self.node.id for n in shard.nodes):
-                sources_exist = True
-            source = self._pick_source(shard)
-            if source is None:
-                continue
-            requested = True
-            self.pending[source] = want
-            self.node.send(source, FetchSnapshot(self.sp.txn_id, want),
-                           callback=self, timeout_s=10.0)
-        if not requested and self.pending:
-            return  # earlier requests for other sub-ranges still in flight
-        if not requested:
-            if sources_exist:
-                # every source tried and failed this round: retry — finishing
-                # without the data would mark the range safe while missing
-                # history and diverge the replica
-                self.tried.clear()
-                self.node.scheduler.once(self.RETRY_DELAY_S,
-                                         self._fetch_missing)
-            else:
-                # genuinely no peer holds it (we are the only replica)
-                self._finish()
-
-    def _pick_source(self, shard) -> Optional[int]:
-        for n in shard.nodes:
-            if n != self.node.id and (n, shard.range.start) not in self.tried:
-                self.tried.add((n, shard.range.start))
-                return n
-        return None
-
-    # ------------------------------------------------------------ replies --
-    def on_success(self, from_id: int, reply) -> None:
+    def _on_fetched(self, fetched: Optional[Ranges], failure) -> None:
         if self.done:
             return
-        want = self.pending.pop(from_id, None)
-        if isinstance(reply, FetchSnapshotOk):
-            self.node.data_store.install_snapshot(reply.snapshot)
-            self.covered = self.covered.union(reply.ranges)
-            if want is not None and not want.subtract(reply.ranges).is_empty:
-                self._fetch_missing()  # partial coverage: try another source
-            elif self.ranges.subtract(self.covered).is_empty:
-                self._finish()
-            elif not self.pending:
-                self._fetch_missing()
+        self.max_applied = getattr(self.fetch_result, "max_applied", None)
+        if failure is not None:
+            # finalize what DID land (watermarks + safe-to-read for the
+            # covered sub-ranges — leaving them un-flipped would wedge reads
+            # on data we installed), then retry the remainder under a fresh
+            # fence
+            self._retry()
+            self.done = True
+            if not self.covered.is_empty:
+                self._fetch_max_conflict(complete=False)
             return
-        # nack: try the next source for that sub-range
-        self._fetch_missing()
-
-    def on_failure(self, from_id: int, failure: BaseException) -> None:
-        if self.done:
-            return
-        self.pending.pop(from_id, None)
-        self._fetch_missing()
+        self._finish()
 
     # ------------------------------------------------------------- finish --
     def _finish(self) -> None:
         if self.done:
             return
         self.done = True
-        self._fetch_max_conflict()
+        self._fetch_max_conflict(complete=True)
 
-    def _fetch_max_conflict(self) -> None:
-        """Before declaring the ranges readable, learn the highest conflict
-        any quorum witnessed for them (reference Bootstrap.java:234
+    def _fetch_max_conflict(self, complete: bool) -> None:
+        """Before declaring ranges readable, learn the highest conflict any
+        quorum witnessed for them (reference Bootstrap.java:234
         FetchMaxConflict): raising our HLC and MaxConflicts above it keeps
-        every timestamp we mint for the new ranges after the handoff point."""
+        every timestamp we mint for the new ranges after the handoff point.
+
+        Always finalizes the FETCHED coverage only (self.covered): after a
+        partial fetch the failed remainder is retried by a new attempt, and
+        after an abort the dropped sub-ranges hold no data — flipping either
+        safe-to-read would serve history we do not have."""
         from accord_tpu.coordinate.fetch import fetch_max_conflict
         from accord_tpu.primitives.keys import Route
-        fetch_max_conflict(self.node, Route.probe(self.ranges),
-                           self.ranges).add_callback(self._on_max_conflict)
+        finalize = self.covered
+        if finalize.is_empty:
+            if complete:
+                self.result.try_success(Ranges.EMPTY)
+            return
+        fetch_max_conflict(self.node, Route.probe(finalize),
+                           finalize).add_callback(
+            lambda mc, f: self._on_max_conflict(finalize, complete, mc, f))
 
-    def _on_max_conflict(self, max_conflict, failure) -> None:
+    def _on_max_conflict(self, finalize: Ranges, complete: bool,
+                         max_conflict, failure) -> None:
         if failure is not None:
-            self.node.scheduler.once(self.RETRY_DELAY_S,
-                                     self._fetch_max_conflict)
+            self.node.scheduler.once(
+                self.RETRY_DELAY_S,
+                lambda: self._fetch_max_conflict(complete))
             return
         from accord_tpu.local import commands as C
         from accord_tpu.local.store import PreLoadContext
         from accord_tpu.primitives.timestamp import NONE as TS_NONE
 
+        if self.max_applied is not None:
+            # source-supplied bound (StartingRangeFetch.started(maxApplied)):
+            # raise our clocks above everything the snapshot contains even if
+            # the global probe raced below it
+            self.node.on_remote_timestamp(self.max_applied)
         if max_conflict > TS_NONE:
             self.node.on_remote_timestamp(max_conflict)
-        for store in self.node.command_stores.intersecting(self.ranges):
-            owned = self.ranges.slice(store.ranges)
+        for store in self.node.command_stores.intersecting(finalize):
+            owned = finalize.slice(store.ranges)
             if owned.is_empty:
                 continue
             store.redundant_before.set_bootstrapped_at(owned, self.sp.txn_id)
@@ -169,4 +162,5 @@ class Bootstrap(Callback):
             # deps below the fence are now satisfied by the snapshot:
             # re-evaluate everything blocked on them
             store.execute(PreLoadContext.empty(), C.re_evaluate_waiting)
-        self.result.try_success(self.ranges)
+        if complete:
+            self.result.try_success(finalize)

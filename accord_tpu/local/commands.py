@@ -453,12 +453,17 @@ def sweep_key_gates(safe_store: SafeCommandStore) -> None:
     for txn_id in list(store.gated):
         cmd = store.commands.get(txn_id)
         waiting_on = cmd.waiting_on if cmd is not None else None
+        if waiting_on is None or not waiting_on.is_waiting_on_key:
+            # purged/truncated/executed with no live key bits: drop the
+            # index entry, or the per-tick sweep runs forever
+            store.gated.pop(txn_id, None)
+            continue
         # snapshot: the drain triggered by _enqueue_notify below removes
         # cleared keys from the live store.gated set
         keys = list(store.gated.get(txn_id, ()))
         live = set()
         for key in keys:
-            if waiting_on is None or not waiting_on.is_waiting_on_key_at(key):
+            if not waiting_on.is_waiting_on_key_at(key):
                 continue
             blockers = _key_gate_blockers(safe_store, safe_store.cfk(key),
                                           cmd, key)
@@ -777,6 +782,7 @@ def purge(safe_store: SafeCommandStore, txn_id: TxnId,
     cmd.partial_deps = None
     cmd.stable_deps = None
     cmd.waiting_on = None
+    safe_store.store.gated.pop(txn_id, None)
     if not keep_outcome:
         cmd.writes = None
         cmd.result = None

@@ -35,9 +35,13 @@ class EpochSyncComplete(Request):
 class FetchSnapshotOk(Reply):
     type = MessageType.FETCH_DATA_RSP
 
-    def __init__(self, snapshot, ranges: Ranges):
+    def __init__(self, snapshot, ranges: Ranges, max_applied=None):
         self.snapshot = snapshot  # opaque DataStore payload
         self.ranges = ranges      # what the peer actually covered
+        # the source's max applied executeAt within `ranges` — the optional
+        # bound of DataStore.StartingRangeFetch.started(maxApplied), letting
+        # the fetcher raise its clocks without a separate global probe
+        self.max_applied = max_applied
 
     def __repr__(self):
         return f"FetchSnapshotOk({self.ranges!r})"
@@ -81,8 +85,15 @@ class FetchSnapshot(Request):
 
         def on_all_applied():
             snap = node.data_store.snapshot_ranges(covered)
+            max_applied = None
+            for s in stores:
+                for key, tfk in s.tfks.items():
+                    if tfk.last_executed is not None and covered.contains(key) \
+                            and (max_applied is None
+                                 or tfk.last_executed > max_applied):
+                        max_applied = tfk.last_executed
             node.reply(from_id, reply_context,
-                       FetchSnapshotOk(snap, covered))
+                       FetchSnapshotOk(snap, covered, max_applied))
 
         def arm(safe_store):
             from accord_tpu.local.status import SaveStatus

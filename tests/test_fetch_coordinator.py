@@ -1,0 +1,153 @@
+"""The ranged fetch coordinator behind DataStore.fetch.
+
+Reference model: impl/AbstractFetchCoordinator.java over FETCH_DATA_REQ
+against the DataStore.java:39-113 callback contract — per-range progress,
+per-shard source failover, max-applied bounds, abort.
+"""
+
+import pytest
+
+from accord_tpu.api.spi import DataStore
+from accord_tpu.impl.list_store import ListQuery, ListRead, ListUpdate
+from accord_tpu.messages.epoch import FetchSnapshot
+from accord_tpu.primitives.keys import Key, Keys, Ranges
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+
+from tests.test_topology_change import run_txn, rw_txn, swap_replica
+
+
+class RecordingFetchRanges(DataStore.FetchRanges):
+    def __init__(self):
+        self.started = []
+        self.fetched_ranges = []
+        self.failed = []
+
+    def starting(self, ranges):
+        self.started.append(ranges)
+        return None
+
+    def fetched(self, ranges):
+        self.fetched_ranges.append(ranges)
+
+    def fail(self, ranges, failure):
+        self.failed.append((ranges, failure))
+
+
+def seed_and_swap(cluster, token=5, values=(0, 1, 2), join=4):
+    for v in values:
+        run_txn(cluster, 1, rw_txn([], {token: v}))
+    cluster.process_all()
+    shard = cluster.topology.shard_for_token(token)
+    leave = shard.nodes[0]
+    return swap_replica(cluster.topology, token, leave, join), leave
+
+
+class TestFetchCoordinator:
+    def test_bootstrap_fetch_reports_per_range_progress(self):
+        """The joining node's bootstrap flows through DataStore.fetch and
+        the coordinator reports fetched coverage via the callbacks."""
+        cluster = SimCluster(n_nodes=4, seed=81, n_shards=2, rf=3)
+        node4 = cluster.node(4)
+        observed = []
+        orig_fetch = node4.data_store.fetch
+
+        def spy_fetch(node, safe_store, ranges, sync_point, fetch_ranges):
+            rec = RecordingFetchRanges()
+
+            class Tee(DataStore.FetchRanges):
+                def starting(self, r):
+                    rec.starting(r)
+                    return fetch_ranges.starting(r)
+
+                def fetched(self, r):
+                    rec.fetched(r)
+                    fetch_ranges.fetched(r)
+
+                def fail(self, r, f):
+                    rec.fail(r, f)
+                    fetch_ranges.fail(r, f)
+
+            observed.append(rec)
+            return orig_fetch(node, safe_store, ranges, sync_point, Tee())
+
+        node4.data_store.fetch = spy_fetch
+        new_top, _leave = seed_and_swap(cluster)
+        cluster.update_topology(new_top)
+        cluster.process_all()
+        assert cluster.node(4).data_store.get(Key(5)) == (0, 1, 2)
+        rec = observed[0]
+        assert rec.started, "no source was ever contacted"
+        got = Ranges.EMPTY
+        for r in rec.fetched_ranges:
+            got = got.union(r)
+        assert Ranges.of((5, 6)).subtract(got).is_empty
+        assert not rec.failed
+
+    def test_fetch_fails_over_to_alternate_source(self):
+        """The first-choice source is cut off: the coordinator tries the
+        shard's other replica and the bootstrap still lands the data."""
+        cluster = SimCluster(n_nodes=4, seed=82, n_shards=2, rf=3)
+        new_top, _leave = seed_and_swap(cluster)
+        shard_nodes = [n for n in cluster.topology.shard_for_token(5).nodes]
+        blocked = shard_nodes[0] if shard_nodes[0] != 4 else shard_nodes[1]
+        cluster.network.add_filter(
+            lambda f, t, m: isinstance(m, FetchSnapshot) and t == blocked)
+        cluster.update_topology(new_top)
+        ok = cluster.process_until(
+            lambda: cluster.node(4).data_store.get(Key(5)) == (0, 1, 2),
+            max_items=2_000_000)
+        assert ok, "bootstrap did not fail over to the alternate source"
+
+    def test_fetch_result_abort_drops_ranges(self):
+        """FetchResult.abort(ranges) makes the coordinator stop fetching the
+        aborted sub-range and settle on the remainder."""
+        from accord_tpu.impl.fetch_coordinator import FetchCoordinator
+        cluster = SimCluster(n_nodes=4, seed=83, n_shards=2, rf=3)
+        seed_and_swap(cluster)  # data exists; topology unchanged
+        node4 = cluster.node(4)
+
+        # block all fetches so the abort happens while in flight
+        fltr = cluster.network.add_filter(
+            lambda f, t, m: isinstance(m, FetchSnapshot))
+        rec = RecordingFetchRanges()
+
+        from accord_tpu.primitives.timestamp import Domain
+        sp_id = node4.next_txn_id(TxnKind.EXCLUSIVE_SYNC_POINT, Domain.RANGE)
+
+        class Sp:
+            txn_id = sp_id
+
+        want = Ranges.of((0, 500))
+        coord = FetchCoordinator(node4, want, Sp(), rec,
+                                 node4.data_store).start()
+        assert coord.inflight, "nothing in flight"
+        coord.result.abort(want)
+        assert coord.done
+        assert coord.result.is_done
+        cluster.network.remove_filter(fltr)
+
+    def test_max_applied_bound_propagates(self):
+        """The source's max applied executeAt rides the snapshot reply and
+        lands in the fetch result (StartingRangeFetch.started(maxApplied))."""
+        cluster = SimCluster(n_nodes=4, seed=84, n_shards=2, rf=3)
+        node4 = cluster.node(4)
+        results = []
+        orig_fetch = node4.data_store.fetch
+
+        def spy_fetch(node, safe_store, ranges, sync_point, fetch_ranges):
+            r = orig_fetch(node, safe_store, ranges, sync_point, fetch_ranges)
+            results.append(r)
+            return r
+
+        node4.data_store.fetch = spy_fetch
+        new_top, _leave = seed_and_swap(cluster)
+        cluster.update_topology(new_top)
+        cluster.process_all()
+        bounds = [getattr(r, "max_applied", None) for r in results
+                  if r.is_done and r.failure() is None]
+        assert any(b is not None for b in bounds), \
+            "no fetch carried the source's max-applied bound"
