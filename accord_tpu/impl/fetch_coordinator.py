@@ -29,29 +29,39 @@ from accord_tpu.primitives.keys import Ranges
 class _Starting:
     """StartingRangeFetch token (DataStore.java:41-61): created when we
     contact a source; `started(max_applied)` hands back an abort handle once
-    the source confirmed its snapshot."""
+    the source confirmed its snapshot.  Forwards to the caller's own token
+    (the return of FetchRanges.starting) so custom FetchRanges
+    implementations observe per-source confirmation too."""
 
-    __slots__ = ("coordinator", "ranges", "source", "aborted")
+    __slots__ = ("coordinator", "ranges", "source", "aborted", "caller_token")
 
     def __init__(self, coordinator: "FetchCoordinator", ranges: Ranges,
-                 source: int):
+                 source: int, caller_token=None):
         self.coordinator = coordinator
         self.ranges = ranges
         self.source = source
         self.aborted = False
+        self.caller_token = caller_token
 
     def started(self, max_applied=None) -> "_Starting":
         if max_applied is not None:
             self.coordinator._observe_max_applied(max_applied)
+        if self.caller_token is not None:
+            self.caller_token.started(max_applied)
         return self  # the AbortFetch handle
 
     def cancel(self) -> None:
         """Abort before any data moved."""
         self.aborted = True
+        if self.caller_token is not None:
+            self.caller_token.cancel()
 
     def abort(self) -> None:
         """Abort after data may have moved (AbortFetch.abort)."""
         self.aborted = True
+        if self.caller_token is not None \
+                and hasattr(self.caller_token, "abort"):
+            self.caller_token.abort()
 
 
 class FetchCoordinator(Callback):
@@ -117,9 +127,9 @@ class FetchCoordinator(Callback):
                     want, TimeoutError(f"all sources tried for {want}"))
                 continue
             requested = True
-            token = _Starting(self, want, source)
+            token = _Starting(self, want, source,
+                              self.fetch_ranges.starting(want))
             self.inflight[source] = (want, token)
-            self.fetch_ranges.starting(want)
             self.node.send(source,
                            FetchSnapshot(self.sync_point.txn_id, want),
                            callback=self, timeout_s=self.timeout_s)
@@ -153,9 +163,12 @@ class FetchCoordinator(Callback):
                 and not token.aborted:
             token.started(reply.max_applied)
             self.data_store.install_snapshot(reply.snapshot)
-            got = reply.ranges
+            # never credit/report sub-ranges aborted while in flight — the
+            # caller dropped them and must not see them bootstrapped
+            got = reply.ranges.subtract(self.aborted)
             self.covered = self.covered.union(got)
-            self.fetch_ranges.fetched(got)
+            if not got.is_empty:
+                self.fetch_ranges.fetched(got)
         self._fetch_missing()
 
     def on_failure(self, from_id: int, failure: BaseException) -> None:

@@ -111,6 +111,19 @@ _WITNESSED_BY = {
     k: KindSet({o for o in TxnKind if k in _WITNESSES[o]}) for k in TxnKind
 }
 
+# -- hot-path lookup tables (derived; the dicts above stay the single source
+#    of truth).  Enum by-value construction costs ~µs per call and the
+#    protocol engine resolves kind/domain/witnesses tens of millions of
+#    times per burn — a tuple index is ~50ns.
+_KIND_BY_INT = tuple(TxnKind(i) if any(int(k) == i for k in TxnKind) else None
+                     for i in range(8))
+_DOMAIN_BY_INT = (Domain.KEY, Domain.RANGE)
+# _WITNESS_BITS[kind_int] bit j set <=> kind witnesses TxnKind(j)
+_WITNESS_BITS = tuple(
+    sum(1 << int(o) for o in _WITNESSES[_KIND_BY_INT[i]])
+    if _KIND_BY_INT[i] is not None else 0
+    for i in range(8))
+
 
 class Timestamp:
     """Immutable 128-bit HLC timestamp + node id.
@@ -119,14 +132,22 @@ class Timestamp:
     reference's msb/lsb/node compare (Timestamp.java compareTo).
     """
 
-    __slots__ = ("epoch", "hlc", "flags", "node")
+    __slots__ = ("epoch", "hlc", "flags", "node", "_cmp")
 
     def __init__(self, epoch: int, hlc: int, flags: int, node: int):
         invariants.check_argument(0 <= epoch <= MAX_EPOCH, "epoch out of range")
+        invariants.check_argument(
+            hlc >> 80 == 0 and flags >> 16 == 0 and node >> 32 == 0
+            and hlc >= 0 and flags >= 0 and node >= 0,
+            "timestamp component out of packing range")
         self.epoch = epoch
         self.hlc = hlc
         self.flags = flags
         self.node = node
+        # packed total-order key: one int comparison per <=> instead of a
+        # tuple build (timestamp compares dominate the host engine — ~45%
+        # of a deep apply-chain profile before this)
+        self._cmp = ((((epoch << 80) | hlc) << 16) | flags) << 32 | node
 
     # -- construction --
     @classmethod
@@ -173,23 +194,20 @@ class Timestamp:
         hlc = ((msb & 0xFFFF) << _HLC_LOW_BITS) | (lsb >> 16)
         return cls(epoch, hlc, lsb & 0xFFFF, node)
 
-    # -- ordering --
-    def _key(self):
-        return (self.epoch, self.hlc, self.flags, self.node)
-
-    def __lt__(self, other): return self._key() < other._key()
-    def __le__(self, other): return self._key() <= other._key()
-    def __gt__(self, other): return self._key() > other._key()
-    def __ge__(self, other): return self._key() >= other._key()
+    # -- ordering (all via the packed key) --
+    def __lt__(self, other): return self._cmp < other._cmp
+    def __le__(self, other): return self._cmp <= other._cmp
+    def __gt__(self, other): return self._cmp > other._cmp
+    def __ge__(self, other): return self._cmp >= other._cmp
 
     def __eq__(self, other):
-        return isinstance(other, Timestamp) and self._key() == other._key()
+        return isinstance(other, Timestamp) and self._cmp == other._cmp
 
     def __hash__(self):
-        return hash(self._key())
+        return hash(self._cmp)
 
     def compare_to(self, other: "Timestamp") -> int:
-        a, b = self._key(), other._key()
+        a, b = self._cmp, other._cmp
         return -1 if a < b else (1 if a > b else 0)
 
     @staticmethod
@@ -226,6 +244,13 @@ class TxnId(Timestamp):
 
     def __init__(self, epoch: int, hlc: int, flags: int, node: int):
         super().__init__(epoch, hlc, flags, node)
+        # validate kind bits at the source (unpack/wire paths take flags
+        # verbatim): a lookup-table miss would otherwise surface later as a
+        # silently thinner deps set.  flags == 0 is the NONE sentinel.
+        invariants.check_argument(
+            flags == 0
+            or _KIND_BY_INT[(flags & _KIND_MASK) >> _KIND_SHIFT] is not None,
+            "invalid TxnKind bits in flags %s", flags)
 
     @classmethod
     def create(cls, epoch: int, hlc: int, kind: TxnKind, domain: Domain,
@@ -239,19 +264,22 @@ class TxnId(Timestamp):
 
     @property
     def kind(self) -> TxnKind:
-        return TxnKind((self.flags & _KIND_MASK) >> _KIND_SHIFT)
+        k = _KIND_BY_INT[(self.flags & _KIND_MASK) >> _KIND_SHIFT]
+        if k is None:  # the NONE sentinel has no kind (matches TxnKind(0))
+            raise ValueError(f"no TxnKind in flags {self.flags:#x}")
+        return k
 
     @property
     def domain(self) -> Domain:
-        return Domain(self.flags & _DOMAIN_MASK)
+        return _DOMAIN_BY_INT[self.flags & _DOMAIN_MASK]
 
     @property
     def is_key_domain(self) -> bool:
-        return self.domain is Domain.KEY
+        return not (self.flags & _DOMAIN_MASK)
 
     @property
     def is_range_domain(self) -> bool:
-        return self.domain is Domain.RANGE
+        return bool(self.flags & _DOMAIN_MASK)
 
     @property
     def is_write(self) -> bool:
@@ -263,7 +291,8 @@ class TxnId(Timestamp):
 
     def witnesses(self, other: "TxnId") -> bool:
         """Must `other` (an earlier txn) appear in this txn's deps?"""
-        return other.kind in self.kind.witnesses()
+        return bool(_WITNESS_BITS[(self.flags & _KIND_MASK) >> _KIND_SHIFT]
+                    >> ((other.flags & _KIND_MASK) >> _KIND_SHIFT) & 1)
 
     def witnessed_by(self, other_kind: TxnKind) -> bool:
         return other_kind in self.kind.witnessed_by()
