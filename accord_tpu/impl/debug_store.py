@@ -1,0 +1,77 @@
+"""Debug command store: store-affinity and safe-store-leak detection.
+
+Reference: accord/impl/InMemoryCommandStore.java:1191 (the Debug variant
+asserting every access runs on the owning store's executor and detecting
+SafeCommandStore references cached past their operation) and the
+CommandStore.current() thread-affinity contract (CommandStore.java:228).
+
+Python has no data-race detector to lean on (the reference treats this
+variant as its TSan stand-in, SURVEY §5.2), so the Debug store checks the
+two invariants that matter in a logically single-threaded-shard design:
+
+* store affinity — every state access happens while THIS store's task is
+  the one running (CommandStore.current() is the owner); a callback that
+  closes over another shard's safe store trips it immediately;
+* use-after-release — a SafeCommandStore reference cached beyond its task
+  (the reference's "leaked safe store") fails on next use instead of
+  silently mutating state outside the executor.
+"""
+
+from __future__ import annotations
+
+from accord_tpu.local.store import (CommandStore, PreLoadContext,
+                                    SafeCommandStore)
+from accord_tpu.utils import invariants
+
+
+class DebugSafeCommandStore(SafeCommandStore):
+    def _check(self) -> None:
+        invariants.check_state(
+            not getattr(self, "released", False),
+            "safe store for %s used after its task completed (leaked "
+            "reference)", self.store)
+        invariants.check_state(
+            CommandStore.current() is self.store,
+            "cross-store access: safe store of %s used while %s is current",
+            self.store, CommandStore.current())
+
+    # every state-touching entry point checks first
+    def get(self, txn_id):
+        self._check()
+        return super().get(txn_id)
+
+    def if_present(self, txn_id):
+        self._check()
+        return super().if_present(txn_id)
+
+    def if_initialised(self, txn_id):
+        self._check()
+        return super().if_initialised(txn_id)
+
+    def register(self, command, status):
+        self._check()
+        return super().register(command, status)
+
+    def register_range_txn(self, command, ranges):
+        self._check()
+        return super().register_range_txn(command, ranges)
+
+    def cfk(self, key):
+        self._check()
+        return super().cfk(key)
+
+    def tfk(self, key):
+        self._check()
+        return super().tfk(key)
+
+    def update_max_conflicts(self, participants, at):
+        self._check()
+        return super().update_max_conflicts(participants, at)
+
+
+class DebugCommandStore(CommandStore):
+    """Drop-in store variant for tests/burns: behaviourally identical, with
+    the Debug assertions armed on every safe-store access."""
+
+    def _make_safe(self, context: PreLoadContext) -> SafeCommandStore:
+        return DebugSafeCommandStore(self, context)

@@ -169,6 +169,10 @@ class FetchCoordinator(Callback):
             self.covered = self.covered.union(got)
             if not got.is_empty:
                 self.fetch_ranges.fetched(got)
+        elif token is not None and not token.aborted:
+            # nack (fence not applied there yet, or not a replica): no data
+            # moved — cancel so caller-side token tracking closes out
+            token.cancel()
         self._fetch_missing()
 
     def on_failure(self, from_id: int, failure: BaseException) -> None:

@@ -420,22 +420,46 @@ class CommandStore:
         self._submit(context, fn, result)
         return result
 
+    # the store whose task is currently running — the single-threaded-shard
+    # affinity check of the reference (CommandStore.current(),
+    # CommandStore.java:228; enforced by the Debug store variant)
+    _current: Optional["CommandStore"] = None
+
+    @classmethod
+    def current(cls) -> Optional["CommandStore"]:
+        return cls._current
+
     def _make_safe(self, context: PreLoadContext) -> SafeCommandStore:
         """The view handed to operations; subclasses may specialise it."""
         return SafeCommandStore(self, context)
 
     def _submit(self, context: PreLoadContext, fn, result: Optional[AsyncResult]
                 ) -> None:
-        """Base: run inline. Overridden by async/simulated stores."""
+        """Base: run inline. Overridden by async/simulated stores.
+
+        Outcome delivery happens AFTER _current/released are restored so
+        success and failure callbacks see identical (post-task) state — a
+        failure callback must trip the Debug leak checks exactly like a
+        success callback would."""
+        value = error = None
+        prev = CommandStore._current
+        safe = None
         try:
-            value = fn(self._make_safe(context))
+            CommandStore._current = self
+            safe = self._make_safe(context)
+            value = fn(safe)
         except BaseException as e:  # noqa: BLE001
+            error = e
+        finally:
+            CommandStore._current = prev
+            if safe is not None:
+                safe.released = True  # leak detection (Debug variant checks)
+        if error is not None:
             if result is not None:
-                result.set_failure(e)
+                result.set_failure(error)
             else:
-                self.agent.on_uncaught_exception(e)
-            return
-        if result is not None:
+                self.agent.on_uncaught_exception(error)
+        elif result is not None:
             result.set_success(value)
 
     def update_ranges(self, ranges: Ranges, unsafe: Ranges = None) -> None:

@@ -137,7 +137,7 @@ class Timestamp:
     def __init__(self, epoch: int, hlc: int, flags: int, node: int):
         invariants.check_argument(0 <= epoch <= MAX_EPOCH, "epoch out of range")
         invariants.check_argument(
-            hlc >> 80 == 0 and flags >> 16 == 0 and node >> 32 == 0
+            hlc >> 64 == 0 and flags >> 16 == 0 and node >> 32 == 0
             and hlc >= 0 and flags >= 0 and node >= 0,
             "timestamp component out of packing range")
         self.epoch = epoch
@@ -301,7 +301,7 @@ class TxnId(Timestamp):
         return Timestamp(self.epoch, self.hlc, self.flags, self.node)
 
     def __repr__(self):
-        if self.msb == 0 and self.lsb == 0 and self.node == 0:
+        if self._cmp == 0:
             return "TxnId.NONE"
         return (f"{self.kind.name[0]}{'R' if self.is_range_domain else ''}"
                 f"[{self.epoch},{self.hlc},{self.node}]")

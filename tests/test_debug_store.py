@@ -1,0 +1,57 @@
+"""Debug command-store variant: affinity + leak checks
+(InMemoryCommandStore.Debug, :1191; CommandStore.current(), :228)."""
+
+import pytest
+
+from accord_tpu.impl.debug_store import DebugCommandStore
+from accord_tpu.local.store import PreLoadContext
+from accord_tpu.primitives.timestamp import Domain, TxnKind
+from accord_tpu.sim.burn import BurnRun
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.utils.invariants import InvariantError
+
+
+def debug_factory(i, node, ranges):
+    return DebugCommandStore(i, node, ranges)
+
+
+class TestDebugStore:
+    def test_leaked_safe_store_detected(self):
+        cluster = SimCluster(n_nodes=1, seed=91, n_shards=1,
+                             store_factory=debug_factory)
+        store = cluster.node(1).command_stores.all()[0]
+        leaked = []
+        store.execute(PreLoadContext.empty(), lambda safe: leaked.append(safe))
+        cluster.process_all()
+        txn_id = cluster.node(1).next_txn_id(TxnKind.WRITE, Domain.KEY)
+        with pytest.raises(InvariantError, match="after its task"):
+            leaked[0].get(txn_id)
+
+    def test_cross_store_access_detected(self):
+        cluster = SimCluster(n_nodes=1, seed=92, n_shards=2,
+                             num_command_stores=2,
+                             store_factory=debug_factory)
+        stores = cluster.node(1).command_stores.all()
+        assert len(stores) >= 2
+        txn_id = cluster.node(1).next_txn_id(TxnKind.WRITE, Domain.KEY)
+        errors = []
+        orig = cluster.node(1).agent.on_uncaught_exception
+        cluster.node(1).agent.on_uncaught_exception = errors.append
+
+        def outer(safe0):
+            # inside store[1]'s (nested) task, touch store[0]'s LIVE safe
+            stores[1].execute(PreLoadContext.empty(),
+                              lambda _safe1: safe0.get(txn_id))
+
+        try:
+            stores[0].execute(PreLoadContext.empty(), outer)
+            cluster.process_all()
+        finally:
+            cluster.node(1).agent.on_uncaught_exception = orig
+        assert errors and isinstance(errors[0], InvariantError)
+        assert "cross-store" in str(errors[0])
+
+    def test_burn_green_under_debug_store(self):
+        stats = BurnRun(seed=93, ops=120, n_shards=4,
+                        store_factory=debug_factory).run()
+        assert stats.acks > 0
