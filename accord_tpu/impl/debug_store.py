@@ -25,48 +25,30 @@ from accord_tpu.utils import invariants
 
 
 class DebugSafeCommandStore(SafeCommandStore):
+    """Every state access in SafeCommandStore — commands, CFKs, watermarks,
+    the conflict-query/recovery scans, progress log, data store — goes
+    through `self.store`, so intercepting that ONE attribute covers the
+    whole surface (including entry points added later) without per-method
+    wrappers."""
+
     def _check(self) -> None:
         invariants.check_state(
             not getattr(self, "released", False),
             "safe store for %s used after its task completed (leaked "
-            "reference)", self.store)
+            "reference)", self._store)
         invariants.check_state(
-            CommandStore.current() is self.store,
+            CommandStore.current() is self._store,
             "cross-store access: safe store of %s used while %s is current",
-            self.store, CommandStore.current())
+            self._store, CommandStore.current())
 
-    # every state-touching entry point checks first
-    def get(self, txn_id):
+    @property
+    def store(self) -> CommandStore:
         self._check()
-        return super().get(txn_id)
+        return self._store
 
-    def if_present(self, txn_id):
-        self._check()
-        return super().if_present(txn_id)
-
-    def if_initialised(self, txn_id):
-        self._check()
-        return super().if_initialised(txn_id)
-
-    def register(self, command, status):
-        self._check()
-        return super().register(command, status)
-
-    def register_range_txn(self, command, ranges):
-        self._check()
-        return super().register_range_txn(command, ranges)
-
-    def cfk(self, key):
-        self._check()
-        return super().cfk(key)
-
-    def tfk(self, key):
-        self._check()
-        return super().tfk(key)
-
-    def update_max_conflicts(self, participants, at):
-        self._check()
-        return super().update_max_conflicts(participants, at)
+    @store.setter
+    def store(self, value: CommandStore) -> None:
+        self._store = value
 
 
 class DebugCommandStore(CommandStore):

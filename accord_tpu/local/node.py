@@ -76,13 +76,16 @@ class Node:
                  progress_log_factory: Callable = None,
                  store_factory: Callable = None,
                  now_us: Callable[[], int] = None,
-                 events: EventsListener = None):
+                 events: EventsListener = None,
+                 trace=None):
+        from accord_tpu.utils.tracing import NO_TRACE
         self.id = node_id
         self.sink = sink
         self.agent = agent
         self.scheduler = scheduler
         self.data_store = data_store
         self.random = random
+        self.trace = trace if trace is not None else NO_TRACE
         self.config = config or LocalConfig.default()
         self.topology = TopologyManager(node_id)
         self.command_stores = CommandStores(self, num_shards,
@@ -113,6 +116,8 @@ class Node:
         ranges newly owned by this node."""
         first = not self.topology.has_epoch(topology.epoch - 1) \
             and self.topology.min_epoch in (0, topology.epoch)
+        if self.trace.enabled:
+            self.trace.event("topology_update", epoch=topology.epoch)
         self.topology.on_topology_update(topology)
         owned = topology.ranges_for_node(self.id)
         added = self.command_stores.update_topology(owned)
@@ -250,6 +255,8 @@ class Node:
             return result
         self.coordinating[txn_id] = result
         result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
+        if self.trace.enabled:
+            self.trace.event("coordinate", txn_id=txn_id, kind=txn.kind.name)
         self.with_epoch(txn_id.epoch,
                         lambda: CoordinateTransaction(self, txn_id, txn,
                                                       result).start())
@@ -264,6 +271,8 @@ class Node:
         result = AsyncResult()
         self.coordinating[txn_id] = result
         result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
+        if self.trace.enabled:
+            self.trace.event("recover", txn_id=txn_id)
         self.with_epoch(txn_id.epoch,
                         lambda: Recover(self, txn_id, route, result).start())
         return result
@@ -279,6 +288,8 @@ class Node:
         result = AsyncResult()
         self.coordinating[txn_id] = result
         result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
+        if self.trace.enabled:
+            self.trace.event("invalidate", txn_id=txn_id)
         self.with_epoch(txn_id.epoch,
                         lambda: Invalidate(self, txn_id, some_route,
                                            result).start())

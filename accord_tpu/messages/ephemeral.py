@@ -71,6 +71,10 @@ class _DepsAppliedWaiter(TransientListener):
 
     def __init__(self, safe_store, dep_ids: List[TxnId], on_ready,
                  deps: "Deps" = None):
+        # on_ready(safe_store) receives the safe store of the task it FIRES
+        # in — a deferred fire happens in a later store task, and using the
+        # arming task's (released) safe store is a leak the Debug store
+        # variant rejects
         self.on_ready = on_ready
         self.pending: Set[TxnId] = set()
         self.fired = False
@@ -102,7 +106,7 @@ class _DepsAppliedWaiter(TransientListener):
                         participants)
         if not self.pending:
             self.fired = True
-            on_ready()
+            on_ready(safe_store)
 
     @staticmethod
     def _cleared(safe_store, cmd) -> bool:
@@ -125,7 +129,7 @@ class _DepsAppliedWaiter(TransientListener):
             self._maybe_drop_created(safe_store, command)
             if not self.pending:
                 self.fired = True
-                self.on_ready()
+                self.on_ready(safe_store)
 
     def _maybe_drop_created(self, safe_store, command) -> None:
         """Remove a record that exists purely because this wait created it:
@@ -142,7 +146,9 @@ class _DepsAppliedWaiter(TransientListener):
 
 
 def wait_for_deps_applied(safe_store, deps: Deps, on_ready) -> None:
-    """Arrange `on_ready` once every locally-owned dep in `deps` has applied."""
+    """Arrange `on_ready(live_safe_store)` once every locally-owned dep in
+    `deps` has applied — the callback receives the safe store of the task it
+    fires in (deferred fires happen in later store tasks)."""
     local = deps.slice(safe_store.ranges) if not safe_store.ranges.is_empty \
         else deps
     _DepsAppliedWaiter(safe_store, local.sorted_txn_ids(), on_ready,
@@ -172,10 +178,12 @@ class ReadEphemeralTxnData(TxnRequest):
         if not safe_store.is_safe_to_read(owned):
             return ReadNack(ReadNack.UNAVAILABLE)
 
-        def do_read():
+        def do_read(live_safe_store):
             # read "now": the snapshot after every collected write dep — the
-            # read mints no timestamp of its own (it is invisible)
-            txn.read_data(safe_store.time_now(), safe_store.data_store,
+            # read mints no timestamp of its own (it is invisible).  Uses
+            # the FIRING task's safe store: the arming one is released.
+            txn.read_data(live_safe_store.time_now(),
+                          live_safe_store.data_store,
                           on_keys=owned).add_callback(
                 lambda data, failure: result.try_failure(failure)
                 if failure is not None else result.try_success(ReadOk(data)))

@@ -50,7 +50,8 @@ class BurnRun:
                  store_factory=None,
                  partitions: bool = False,
                  partition_period_s: float = 8.0,
-                 clock_drift: bool = False):
+                 clock_drift: bool = False,
+                 trace: bool = False):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -64,7 +65,8 @@ class BurnRun:
             n_nodes=nodes, seed=self.rng.next_long(), n_shards=n_shards,
             rf=rf, progress_log_factory=progress_log_factory,
             num_command_stores=num_command_stores,
-            store_factory=store_factory, clock_drift=clock_drift)
+            store_factory=store_factory, clock_drift=clock_drift,
+            trace=trace)
         if drop_prob > 0:
             self.cluster.network.default_link = LinkConfig(
                 deliver_prob=1.0 - drop_prob)
@@ -261,6 +263,9 @@ def main(argv=None) -> int:
                         help="device-store flush window (virtual us)")
     parser.add_argument("--message-stats", action="store_true",
                         help="print per-message-type delivery/drop counters")
+    parser.add_argument("--trace", action="store_true",
+                        help="record structured protocol events per node and "
+                             "print the tail after the run")
     args = parser.parse_args(argv)
     store_factory = None
     if args.device_store:
@@ -278,8 +283,14 @@ def main(argv=None) -> int:
                       n_shards=args.shards, drop_prob=args.drop,
                       store_factory=store_factory,
                       num_command_stores=args.stores,
-                      partitions=args.partitions, clock_drift=args.drift)
+                      partitions=args.partitions, clock_drift=args.drift,
+                      trace=args.trace)
         stats = run.run()
+        if args.trace:
+            for node in run.cluster.nodes.values():
+                dump = node.trace.dump(limit=40)
+                if dump:
+                    print(dump)
         extra = ""
         if args.device_store:
             h = m = b = p = 0

@@ -78,7 +78,8 @@ class SimCluster:
                  n_shards: int = 2, rf: int = None, num_command_stores: int = 1,
                  progress_log_factory: Optional[Callable] = None,
                  store_factory: Optional[Callable] = None,
-                 clock_drift: bool = False, journal: bool = True):
+                 clock_drift: bool = False, journal: bool = True,
+                 trace: bool = False):
         self.random = RandomSource(seed)
         self.queue = PendingQueue(self.random.fork())
         self.network = SimNetwork(self.queue, self.random.fork())
@@ -100,12 +101,16 @@ class SimCluster:
             now_us = (DriftingClock(self.queue.clock, self.random.fork()).now_us
                       if clock_drift
                       else (lambda: self.queue.clock.now_us))
+            from accord_tpu.utils.tracing import Trace
             node = Node(
                 nid, sink, agent, self.scheduler, ListStore(nid),
                 self.random.fork(), num_shards=num_command_stores,
                 progress_log_factory=progress_log_factory,
                 store_factory=store_factory,
                 now_us=now_us,
+                trace=Trace(nid, enabled=True,
+                            clock=lambda: self.queue.clock.now_us / 1e6)
+                if trace else None,
             )
             node.journal = self.journal
             self.agents[nid] = agent

@@ -26,6 +26,9 @@ class TestDebugStore:
         txn_id = cluster.node(1).next_txn_id(TxnKind.WRITE, Domain.KEY)
         with pytest.raises(InvariantError, match="after its task"):
             leaked[0].get(txn_id)
+        # the conflict-query/read path is covered too (store-property hook)
+        with pytest.raises(InvariantError, match="after its task"):
+            _ = leaked[0].ranges
 
     def test_cross_store_access_detected(self):
         cluster = SimCluster(n_nodes=1, seed=92, n_shards=2,

@@ -1,0 +1,32 @@
+"""Structured protocol-event tracing (the reference's slf4j + burn Trace
+logger, Cluster.java:104, repackaged as utils/tracing.Trace)."""
+
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.utils.tracing import Trace
+from tests.test_topology_change import run_txn, rw_txn
+
+
+def test_trace_records_protocol_events():
+    cluster = SimCluster(n_nodes=3, seed=71, trace=True)
+    run_txn(cluster, 1, rw_txn([], {5: 1}))
+    cluster.process_all()
+    node1 = cluster.node(1)
+    coords = node1.trace.events("coordinate")
+    assert coords and coords[0][3]["kind"] == "WRITE"
+    assert node1.trace.events("topology_update")
+    assert "coordinate" in node1.trace.dump()
+
+
+def test_trace_disabled_is_inert():
+    t = Trace(1, enabled=False)
+    t.event("anything", x=1)
+    assert not t.events()
+    assert t.dump() == ""
+
+
+def test_trace_ring_is_bounded():
+    t = Trace(1, enabled=True, capacity=10)
+    for i in range(100):
+        t.event("e", i=i)
+    assert len(t.events()) == 10
+    assert t.events()[0][3]["i"] == 90
