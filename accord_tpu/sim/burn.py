@@ -91,7 +91,14 @@ class BurnRun:
             self.nemesis = TopologyRandomizer(self.cluster, self.rng.fork(),
                                               period_s=topology_period_s)
             self.nemesis.start()
-        self.verifier = StrictSerializabilityVerifier()
+        # two unrelated checking algorithms must both pass, like the
+        # reference's own verifier composed with Elle (CompositeVerifier +
+        # ElleVerifier.java:47): cycle detection on the constraint graph,
+        # and explicit witness construction + model replay
+        from accord_tpu.sim.verify_replay import (CompositeVerifier,
+                                                  WitnessReplayVerifier)
+        self.verifier = CompositeVerifier(StrictSerializabilityVerifier(),
+                                          WitnessReplayVerifier())
         self.stats = BurnStats()
         self.next_value = 0
         self._value_owner: Dict[int, dict] = {}

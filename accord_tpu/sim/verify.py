@@ -39,6 +39,34 @@ class Violation(AssertionError):
     pass
 
 
+def real_time_edges(obs: Sequence[Observation], add_edge) -> None:
+    """Reduced real-time precedence: a -> every b starting in (end_a, m]
+    where m is the minimum end among txns starting after end_a — any
+    later-starting txn is reachable transitively through one of those.
+    Shared by both checkers (the reduction itself is infrastructure, not
+    part of either checking algorithm)."""
+    from bisect import bisect_right
+    n = len(obs)
+    order = sorted(range(n), key=lambda i: obs[i].start_us)
+    starts = [obs[i].start_us for i in order]
+    suffix_min_end: List[Optional[int]] = [None] * n
+    running: Optional[int] = None
+    for k in range(n - 1, -1, -1):
+        e = obs[order[k]].end_us
+        running = e if running is None or e < running else running
+        suffix_min_end[k] = running
+    for ai in range(n):
+        a = order[ai]
+        j = bisect_right(starts, obs[a].end_us, lo=ai + 1)
+        if j >= n:
+            continue
+        bound = suffix_min_end[j]
+        k = j
+        while k < n and starts[k] <= bound:
+            add_edge(a, order[k])
+            k += 1
+
+
 class StrictSerializabilityVerifier:
     def __init__(self):
         self.observations: List[Observation] = []
@@ -113,29 +141,7 @@ class StrictSerializabilityVerifier:
                         add_edge(w, i)
                     else:
                         add_edge(i, w)
-        # real-time: o1 ended before o2 started. The full relation is O(n^2);
-        # we add only non-transitively-implied edges: a -> every b starting in
-        # (end_a, m] where m is the minimum end among txns starting after
-        # end_a — any later-starting txn is reachable through that one.
-        order = sorted(range(n), key=lambda i: obs[i].start_us)
-        starts = [obs[i].start_us for i in order]
-        suffix_min_end: List[int] = [0] * n
-        running = None
-        for k in range(n - 1, -1, -1):
-            e = obs[order[k]].end_us
-            running = e if running is None or e < running else running
-            suffix_min_end[k] = running
-        import bisect as _bisect
-        for ai in range(n):
-            a = order[ai]
-            j = _bisect.bisect_right(starts, obs[a].end_us, lo=ai + 1)
-            if j >= n:
-                continue
-            m = suffix_min_end[j]
-            k = j
-            while k < n and starts[k] <= m:
-                add_edge(a, order[k])
-                k += 1
+        real_time_edges(obs, add_edge)
 
         self._check_acyclic(edges)
 
