@@ -174,6 +174,208 @@ PyObject *binary_search(PyObject *, PyObject *args) {
     return PyLong_FromSsize_t(-(lo + 1));
 }
 
+/* k-way union of sorted unique sequences: iterative pairwise merge run
+ * entirely natively (the RelationMultiMap.LinearMerger id-pool union). */
+PyObject *merge_two(PyObject *ao, PyObject *bo) {
+    PyObject *args = PyTuple_Pack(2, ao, bo);
+    if (args == nullptr) return nullptr;
+    PyObject *out = linear_union(nullptr, args);
+    Py_DECREF(args);
+    return out;
+}
+
+PyObject *linear_merge_n(PyObject *, PyObject *args) {
+    PyObject *listso;
+    if (!PyArg_ParseTuple(args, "O", &listso)) return nullptr;
+    FastSeq lists;
+    if (!lists.init(listso)) return nullptr;
+    if (lists.n == 0) return PyList_New(0);
+    PyObject *acc = PySequence_List(lists.items[0]);
+    if (acc == nullptr) return nullptr;
+    for (Py_ssize_t i = 1; i < lists.n; ++i) {
+        PyObject *next = merge_two(acc, lists.items[i]);
+        Py_DECREF(acc);
+        if (next == nullptr) return nullptr;
+        acc = next;
+    }
+    return acc;
+}
+
+/* ---- CINTIA checkpoint-interval stabbing over int64 interval arrays ----
+ * Mirrors accord_tpu/utils/checkpoint_intervals.py exactly (reference
+ * CheckpointIntervalArray.java:28-84): same checkpoint layout, same visit
+ * order. Values must fit int64; the Python tier handles anything wider.
+ *
+ * cintia_build converts once and returns an opaque capsule holding the
+ * int64 arrays (intervals + checkpoint CSR); queries run against the
+ * capsule with NO per-query marshalling — the O(lg N + K) contract holds
+ * natively. */
+
+struct Cintia {
+    long long *starts = nullptr, *ends = nullptr;
+    long long *offsets = nullptr, *entries = nullptr;
+    Py_ssize_t n = 0, n_offsets = 0, n_entries = 0, every = 1;
+
+    ~Cintia() {
+        PyMem_Free(starts); PyMem_Free(ends);
+        PyMem_Free(offsets); PyMem_Free(entries);
+    }
+};
+
+void cintia_destroy(PyObject *capsule) {
+    delete (Cintia *)PyCapsule_GetPointer(capsule, "accord.cintia");
+}
+
+long long *to_i64(PyObject *obj, Py_ssize_t *out_n) {
+    FastSeq seq;
+    if (!seq.init(obj)) return nullptr;
+    *out_n = seq.n;
+    long long *v = (long long *)PyMem_Malloc(
+        sizeof(long long) * (seq.n ? seq.n : 1));
+    if (v == nullptr) { PyErr_NoMemory(); return nullptr; }
+    for (Py_ssize_t i = 0; i < seq.n; ++i) {
+        long long x = PyLong_AsLongLong(seq.items[i]);
+        if (x == -1 && PyErr_Occurred()) { PyMem_Free(v); return nullptr; }
+        v[i] = x;
+    }
+    return v;
+}
+
+/* count of elements <= x (bisect_right) / < x (bisect_left) */
+inline Py_ssize_t upper_bound(const long long *v, Py_ssize_t n, long long x) {
+    Py_ssize_t lo = 0, hi = n;
+    while (lo < hi) {
+        Py_ssize_t mid = (lo + hi) / 2;
+        if (v[mid] <= x) lo = mid + 1; else hi = mid;
+    }
+    return lo;
+}
+inline Py_ssize_t lower_bound(const long long *v, Py_ssize_t n, long long x) {
+    Py_ssize_t lo = 0, hi = n;
+    while (lo < hi) {
+        Py_ssize_t mid = (lo + hi) / 2;
+        if (v[mid] < x) lo = mid + 1; else hi = mid;
+    }
+    return lo;
+}
+
+PyObject *cintia_build(PyObject *, PyObject *args) {
+    PyObject *so, *eo;
+    Py_ssize_t every;
+    if (!PyArg_ParseTuple(args, "OOn", &so, &eo, &every)) return nullptr;
+    Cintia *c = new Cintia();
+    c->every = every > 0 ? every : 1;
+    Py_ssize_t n_ends = 0;
+    c->starts = to_i64(so, &c->n);
+    if (c->starts == nullptr) { delete c; return nullptr; }
+    c->ends = to_i64(eo, &n_ends);
+    if (c->ends == nullptr || n_ends != c->n) {
+        delete c;
+        if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_ValueError, "starts/ends length mismatch");
+        return nullptr;
+    }
+    Py_ssize_t n_cp = c->n ? (c->n + c->every - 1) / c->every : 0;
+    c->offsets = (long long *)PyMem_Malloc(
+        sizeof(long long) * (n_cp ? n_cp : 1));
+    if (c->offsets == nullptr) { delete c; PyErr_NoMemory(); return nullptr; }
+    /* two passes: count then fill */
+    Py_ssize_t total = 0;
+    for (Py_ssize_t cp = c->every; cp < c->n; cp += c->every) {
+        long long boundary = c->starts[cp];
+        for (Py_ssize_t i = 0; i < cp; ++i)
+            if (c->ends[i] > boundary) ++total;
+    }
+    c->entries = (long long *)PyMem_Malloc(
+        sizeof(long long) * (total ? total : 1));
+    if (c->entries == nullptr) { delete c; PyErr_NoMemory(); return nullptr; }
+    Py_ssize_t e = 0, ci = 0;
+    for (Py_ssize_t cp = 0; cp < c->n; cp += c->every) {
+        if (cp > 0) {
+            long long boundary = c->starts[cp];
+            for (Py_ssize_t i = 0; i < cp; ++i)
+                if (c->ends[i] > boundary) c->entries[e++] = i;
+        }
+        c->offsets[ci++] = e;
+    }
+    c->n_offsets = ci;
+    c->n_entries = e;
+    PyObject *capsule = PyCapsule_New(c, "accord.cintia", cintia_destroy);
+    if (capsule == nullptr) delete c;
+    return capsule;
+}
+
+inline Cintia *get_cintia(PyObject *capsule) {
+    return (Cintia *)PyCapsule_GetPointer(capsule, "accord.cintia");
+}
+
+/* visit checkpoint-open intervals for the block of `j` (count of starts <=
+ * point), then the run [cp, j), appending indices with end > point */
+bool visit_stab(const Cintia *c, long long point, Py_ssize_t j,
+                PyObject *out) {
+    if (j == 0) return true;
+    Py_ssize_t cp = ((j - 1) / c->every) * c->every;
+    Py_ssize_t ci = cp / c->every;
+    Py_ssize_t lo = ci > 0 ? (Py_ssize_t)c->offsets[ci - 1] : 0;
+    Py_ssize_t hi = (Py_ssize_t)c->offsets[ci];
+    for (Py_ssize_t e = lo; e < hi; ++e) {
+        Py_ssize_t i = (Py_ssize_t)c->entries[e];
+        if (c->ends[i] > point) {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            if (idx == nullptr || PyList_Append(out, idx) < 0) {
+                Py_XDECREF(idx); return false;
+            }
+            Py_DECREF(idx);
+        }
+    }
+    for (Py_ssize_t i = cp; i < j; ++i) {
+        if (c->ends[i] > point) {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            if (idx == nullptr || PyList_Append(out, idx) < 0) {
+                Py_XDECREF(idx); return false;
+            }
+            Py_DECREF(idx);
+        }
+    }
+    return true;
+}
+
+PyObject *cintia_find(PyObject *, PyObject *args) {
+    PyObject *capsule;
+    long long point;
+    if (!PyArg_ParseTuple(args, "OL", &capsule, &point)) return nullptr;
+    Cintia *c = get_cintia(capsule);
+    if (c == nullptr) return nullptr;
+    PyObject *out = PyList_New(0);
+    if (out == nullptr) return nullptr;
+    Py_ssize_t j = upper_bound(c->starts, c->n, point);
+    if (!visit_stab(c, point, j, out)) { Py_DECREF(out); return nullptr; }
+    return out;
+}
+
+PyObject *cintia_overlaps(PyObject *, PyObject *args) {
+    PyObject *capsule;
+    long long qlo, qhi;
+    if (!PyArg_ParseTuple(args, "OLL", &capsule, &qlo, &qhi)) return nullptr;
+    Cintia *c = get_cintia(capsule);
+    if (c == nullptr) return nullptr;
+    PyObject *out = PyList_New(0);
+    if (out == nullptr) return nullptr;
+    Py_ssize_t j = lower_bound(c->starts, c->n, qhi);
+    if (j > 0) {
+        Py_ssize_t jlo = upper_bound(c->starts, c->n, qlo);
+        if (!visit_stab(c, qlo, jlo, out)) { Py_DECREF(out); return nullptr; }
+        for (Py_ssize_t i = jlo; i < j; ++i) {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            if (idx == nullptr || PyList_Append(out, idx) < 0) {
+                Py_XDECREF(idx); Py_DECREF(out); return nullptr;
+            }
+            Py_DECREF(idx);
+        }
+    }
+    return out;
+}
+
 PyMethodDef methods[] = {
     {"linear_union", linear_union, METH_VARARGS,
      "union of two sorted unique sequences"},
@@ -183,6 +385,14 @@ PyMethodDef methods[] = {
      "difference of two sorted unique sequences"},
     {"binary_search", binary_search, METH_VARARGS,
      "Java-convention binary search"},
+    {"linear_merge_n", linear_merge_n, METH_VARARGS,
+     "k-way union of sorted unique sequences"},
+    {"cintia_build", cintia_build, METH_VARARGS,
+     "build checkpoint lists for the interval index"},
+    {"cintia_find", cintia_find, METH_VARARGS,
+     "stabbing query: indices of intervals containing a point"},
+    {"cintia_overlaps", cintia_overlaps, METH_VARARGS,
+     "overlap query: indices of intervals intersecting [lo, hi)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -18,7 +18,8 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence,
 from accord_tpu.primitives.keys import Key, Keys, Range, Ranges, RoutingKey, RoutingKeys
 from accord_tpu.primitives.timestamp import TxnId
 from accord_tpu.utils import invariants
-from accord_tpu.utils.sorted_arrays import find_ceil, linear_union
+from accord_tpu.utils.sorted_arrays import (find_ceil, linear_merge_n,
+                                            linear_union)
 
 
 def _build_csr(sorted_lhs: Sequence, lhs_to_sets: Dict, sorted_rhs: Sequence
@@ -250,9 +251,8 @@ class KeyDeps:
             return KeyDeps.NONE
         if len(live) == 1:
             return live[0]
-        merged_ids: Sequence[TxnId] = live[0].txn_ids
-        for d in live[1:]:
-            merged_ids = linear_union(merged_ids, d.txn_ids)
+        merged_ids: Sequence[TxnId] = linear_merge_n(
+            [d.txn_ids for d in live])
         remaps = [d._remap_into(merged_ids) for d in live]
         idxs = [0] * len(live)
         out_keys: List[Key] = []

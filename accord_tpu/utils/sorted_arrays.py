@@ -116,6 +116,17 @@ def linear_union(a: Sequence[T], b: Sequence[T]) -> list:
     return out
 
 
+def linear_merge_n(lists: Sequence[Sequence[T]]) -> list:
+    """k-way union of sorted unique sequences (the id-pool union of
+    RelationMultiMap.LinearMerger): iterative pairwise merge."""
+    if not lists:
+        return []
+    acc = list(lists[0])
+    for nxt in lists[1:]:
+        acc = linear_union(acc, nxt)
+    return acc
+
+
 def linear_intersection(a: Sequence[T], b: Sequence[T]) -> list:
     out: list = []
     i = j = 0
@@ -199,12 +210,15 @@ py_linear_union = linear_union
 py_linear_intersection = linear_intersection
 py_linear_subtract = linear_subtract
 py_binary_search = binary_search
+py_linear_merge_n = linear_merge_n
 
 if _native.AVAILABLE:  # pragma: no branch
     _m = _native.get()
     linear_union = _m.linear_union
     linear_intersection = _m.linear_intersection
     linear_subtract = _m.linear_subtract
+    if hasattr(_m, "linear_merge_n"):  # older cached .so may predate it
+        linear_merge_n = _m.linear_merge_n
 
     def binary_search(xs, target, lo=0, hi=None,  # noqa: F811
                       mode: Search = Search.FAST) -> int:

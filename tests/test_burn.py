@@ -83,6 +83,25 @@ class TestBurn:
               for n in r2.cluster.nodes}
         assert h1 == h2  # same seed, same world
 
+    def test_burn_reconcile_event_streams(self):
+        """The reference's reconcile mode runs the same seed twice and
+        asserts the captured logs are identical (BurnTest.java:290-313,
+        ReconcilingLogger) — here the per-node structured trace streams must
+        match event for event, a far stronger determinism check than
+        comparing end states."""
+        def traced_run():
+            r = BurnRun(17, ops=60, trace=True)
+            r.run()
+            return {n: list(r.cluster.node(n).trace.ring)
+                    for n in r.cluster.nodes}
+
+        t1 = traced_run()
+        t2 = traced_run()
+        assert t1.keys() == t2.keys()
+        for n in t1:
+            assert t1[n] == t2[n], f"node {n} event streams diverged"
+        assert any(t1[n] for n in t1), "no events were traced"
+
     def test_burn_partial_rf(self):
         # rf 3 of 5 nodes: not every node replicates every key
         stats = BurnRun(42, ops=60, nodes=5, rf=3, n_shards=4).run()

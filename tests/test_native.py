@@ -102,3 +102,63 @@ class TestNativeKernels:
 
         with pytest.raises(ValueError):
             m.linear_union([Evil()], [Evil()])
+
+
+class TestNativeMergeN:
+    def test_matches_python_merge_n(self):
+        from accord_tpu.utils.sorted_arrays import py_linear_merge_n
+        m = native.get()
+
+        def prop(lists):
+            assert m.linear_merge_n(lists) == py_linear_merge_n(lists)
+
+        for_all(Gens.lists(sorted_unique(), max_size=6), examples=150)(prop)
+
+    def test_merges_txn_ids(self):
+        m = native.get()
+        mk = lambda h: TxnId.create(1, h, TxnKind.WRITE, Domain.KEY, 0)
+        a = [mk(1), mk(5)]
+        b = [mk(3), mk(5), mk(9)]
+        c = [mk(2)]
+        got = m.linear_merge_n([a, b, c])
+        assert got == sorted(set(a) | set(b) | set(c))
+
+    def test_empty(self):
+        m = native.get()
+        assert m.linear_merge_n([]) == []
+        assert m.linear_merge_n([[], []]) == []
+
+
+class TestNativeCintia:
+    def test_matches_python_tier_and_oracle(self):
+        from accord_tpu.utils.checkpoint_intervals import (
+            CheckpointIntervalIndex)
+        rng = random.Random(5)
+        for trial in range(40):
+            n = rng.randint(0, 40)
+            starts = sorted(rng.randint(0, 100) for _ in range(n))
+            ends = [s + 1 + rng.randint(0, 30) for s in starts]
+            idx = CheckpointIntervalIndex(starts, ends, every=4)
+            assert idx._capsule is not None, "native CINTIA not active"
+            for point in (0, 5, 50, 99, 131):
+                got = []
+                idx.find(point, got.append)
+                assert got == CheckpointIntervalIndex.brute(
+                    starts, ends, point)
+            lo = rng.randint(0, 100)
+            hi = lo + rng.randint(1, 40)
+            got = []
+            idx.find_overlaps(lo, hi, got.append)
+            want = [i for i in range(n)
+                    if starts[i] < hi and ends[i] > lo]
+            assert got == want
+
+    def test_wide_tokens_fall_back_to_python(self):
+        from accord_tpu.utils.checkpoint_intervals import (
+            CheckpointIntervalIndex)
+        big = 1 << 70  # beyond int64
+        idx = CheckpointIntervalIndex([0, big], [big + 1, big + 2], every=1)
+        assert idx._capsule is None
+        got = []
+        idx.find(big, got.append)
+        assert got == [0, 1]
