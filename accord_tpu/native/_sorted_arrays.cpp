@@ -279,22 +279,28 @@ PyObject *cintia_build(PyObject *, PyObject *args) {
     c->offsets = (long long *)PyMem_Malloc(
         sizeof(long long) * (n_cp ? n_cp : 1));
     if (c->offsets == nullptr) { delete c; PyErr_NoMemory(); return nullptr; }
-    /* two passes: count then fill */
-    Py_ssize_t total = 0;
-    for (Py_ssize_t cp = c->every; cp < c->n; cp += c->every) {
-        long long boundary = c->starts[cp];
-        for (Py_ssize_t i = 0; i < cp; ++i)
-            if (c->ends[i] > boundary) ++total;
-    }
-    c->entries = (long long *)PyMem_Malloc(
-        sizeof(long long) * (total ? total : 1));
+    /* single pass; entries grows by doubling */
+    Py_ssize_t cap = 16;
+    c->entries = (long long *)PyMem_Malloc(sizeof(long long) * cap);
     if (c->entries == nullptr) { delete c; PyErr_NoMemory(); return nullptr; }
     Py_ssize_t e = 0, ci = 0;
     for (Py_ssize_t cp = 0; cp < c->n; cp += c->every) {
         if (cp > 0) {
             long long boundary = c->starts[cp];
-            for (Py_ssize_t i = 0; i < cp; ++i)
-                if (c->ends[i] > boundary) c->entries[e++] = i;
+            for (Py_ssize_t i = 0; i < cp; ++i) {
+                if (c->ends[i] > boundary) {
+                    if (e == cap) {
+                        cap *= 2;
+                        long long *grown = (long long *)PyMem_Realloc(
+                            c->entries, sizeof(long long) * cap);
+                        if (grown == nullptr) {
+                            delete c; PyErr_NoMemory(); return nullptr;
+                        }
+                        c->entries = grown;
+                    }
+                    c->entries[e++] = i;
+                }
+            }
         }
         c->offsets[ci++] = e;
     }

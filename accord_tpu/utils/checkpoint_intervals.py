@@ -42,6 +42,8 @@ class CheckpointIntervalIndex:
         assert n == len(ends)
         assert all(starts[i] <= starts[i + 1] for i in range(n - 1)), \
             "intervals must be sorted by start"
+        if every <= 0:
+            raise ValueError("checkpoint spacing must be positive")
         self.starts = list(starts)
         self.ends = list(ends)
         self._every = every
@@ -52,7 +54,9 @@ class CheckpointIntervalIndex:
             try:
                 self._capsule = _native_mod.cintia_build(
                     self.starts, self.ends, every)
-            except OverflowError:  # tokens wider than int64: Python tier
+            except (OverflowError, TypeError):
+                # tokens wider than int64, or non-int comparables (any
+                # ordered numbers work on the Python tier): fall back
                 self._capsule = None
         self._cp_offsets = None  # built lazily when the Python tier is used
         self._cp_entries = None
@@ -88,7 +92,7 @@ class CheckpointIntervalIndex:
         if self._capsule is not None:
             try:
                 found = _native_mod.cintia_find(self._capsule, point)
-            except OverflowError:  # query point wider than int64
+            except (OverflowError, TypeError):  # point outside int64 / non-int
                 found = None
             if found is not None:
                 # callbacks run OUTSIDE the try: their own exceptions must
@@ -118,7 +122,7 @@ class CheckpointIntervalIndex:
         if self._capsule is not None:
             try:
                 found = _native_mod.cintia_overlaps(self._capsule, lo, hi)
-            except OverflowError:
+            except (OverflowError, TypeError):
                 found = None
             if found is not None:
                 for i in found:

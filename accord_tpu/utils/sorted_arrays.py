@@ -177,12 +177,10 @@ def next_intersection(a: Sequence, ai: int, b: Sequence, bi: int):
 
 
 def merge_sorted_unique(arrays: Sequence[Sequence[T]]) -> list:
-    """N-way union (reference: RelationMultiMap.LinearMerger shape)."""
-    result: list = []
-    for arr in arrays:
-        if arr:
-            result = linear_union(result, arr) if result else list(arr)
-    return result
+    """N-way union (reference: RelationMultiMap.LinearMerger shape).
+    Alias of linear_merge_n, kept for its established callers — the
+    call-time lookup picks up the native binding when available."""
+    return linear_merge_n([a for a in arrays if a])
 
 
 def fold_intersection(a: Sequence, b: Sequence, fn: Callable, acc):

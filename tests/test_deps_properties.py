@@ -1,0 +1,168 @@
+"""Randomized CSR-invariant properties for the deps structures.
+
+Reference model: KeyDepsTest (586 LoC of randomized CSR invariants),
+RangeDepsTest — the reference's heaviest unit tier.  Every algebraic
+operation (merge, with_, without, slice, participants, inversion) is checked
+against a plain dict/set model on seeded random instances, with shrinking on
+failure (utils/property.py).
+"""
+
+import pytest
+
+from accord_tpu.primitives.deps import Deps, KeyDeps, RangeDeps
+from accord_tpu.primitives.keys import Key, Range, Ranges
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+from accord_tpu.utils.property import Gens, for_all
+
+
+def tid(h, node=1, kind=TxnKind.WRITE, domain=Domain.KEY):
+    return TxnId.create(1, h, kind, domain, node)
+
+
+def key_deps_model():
+    """Generator of {Key: set(TxnId)} dict models."""
+    pair = Gens.tuples(Gens.ints(0, 15), Gens.ints(1, 60))
+    return Gens.lists(pair, max_size=40).map(
+        lambda ps: {Key(k): {tid(h, node=1 + h % 3) for k2, h in ps
+                             if k2 == k}
+                    for k, _ in ps})
+
+
+def as_model(d: KeyDeps):
+    return {k: set(d.txn_ids_for_key(k)) for k in d.keys
+            if d.txn_ids_for_key(k)}
+
+
+def model_union(*models):
+    out = {}
+    for m in models:
+        for k, v in m.items():
+            if v:
+                out.setdefault(k, set()).update(v)
+    return out
+
+
+class TestKeyDepsAlgebra:
+    def test_merge_matches_model_and_order_invariance(self):
+        def prop(models):
+            ds = [KeyDeps.of(m) for m in models]
+            merged = KeyDeps.merge(ds)
+            assert as_model(merged) == model_union(*models)
+            # order invariance
+            assert KeyDeps.merge(list(reversed(ds))) == merged
+            # idempotence
+            assert KeyDeps.merge([merged, merged]) == merged
+            # pairwise association
+            acc = KeyDeps.NONE
+            for d in ds:
+                acc = acc.with_(d)
+            assert acc == merged or (acc.is_empty and merged.is_empty)
+
+        for_all(Gens.lists(key_deps_model(), max_size=5),
+                examples=120)(prop)
+
+    def test_without_complement(self):
+        def prop(m, cut):
+            d = KeyDeps.of(m)
+            pred = lambda t: t.hlc < cut
+            kept = d.without(pred)
+            dropped = d.without(lambda t: not pred(t))
+            # kept ∪ dropped == original, kept ∩ dropped == ∅ (per key)
+            assert model_union(as_model(kept), as_model(dropped)) \
+                == as_model(d)
+            for k in as_model(kept):
+                assert not (as_model(kept)[k]
+                            & as_model(dropped).get(k, set()))
+            for k in as_model(kept):
+                assert all(t.hlc >= cut for t in as_model(kept)[k])
+
+        for_all(key_deps_model(), Gens.ints(1, 60), examples=120)(prop)
+
+    def test_slice_partition(self):
+        def prop(m, split):
+            d = KeyDeps.of(m)
+            lo = d.slice(Ranges.of((0, split)))
+            hi = d.slice(Ranges.of((split, 1 << 30)))
+            assert model_union(as_model(lo), as_model(hi)) == as_model(d)
+            assert all(k.token < split for k in as_model(lo))
+            assert all(k.token >= split for k in as_model(hi))
+
+        for_all(key_deps_model(), Gens.ints(1, 15), examples=120)(prop)
+
+    def test_participants_inverts_the_map(self):
+        def prop(m):
+            d = KeyDeps.of(m)
+            ids = set()
+            d.for_each_unique_txn_id(ids.add)
+            assert ids == set().union(*m.values()) if m else not ids
+            for t in ids:
+                want = {k for k, v in m.items() if t in v}
+                assert set(d.participants(t)) == want
+                assert d.contains(t)
+
+        for_all(key_deps_model(), examples=120)(prop)
+
+
+def range_deps_model():
+    """Generator of {Range: set(TxnId)} models over token intervals."""
+    item = Gens.tuples(Gens.ints(0, 90), Gens.ints(1, 12), Gens.ints(1, 60))
+    return Gens.lists(item, max_size=25).map(
+        lambda ps: {Range(lo, lo + w): {tid(h, kind=TxnKind.WRITE,
+                                            domain=Domain.RANGE)
+                                        for lo2, w2, h in ps
+                                        if (lo2, w2) == (lo, w)}
+                    for lo, w, _ in ps})
+
+
+class TestRangeDepsAlgebra:
+    def test_merge_and_stab_match_model(self):
+        def prop(models, point):
+            ds = [RangeDeps.of(m) for m in models]
+            merged = RangeDeps.merge(ds)
+            union = model_union(*models)
+            want = set()
+            for r, v in union.items():
+                if r.start <= point < r.end:
+                    want.update(v)
+            got = set()
+            from accord_tpu.primitives.keys import RoutingKey
+            merged.for_each_covering(RoutingKey(point), got.add)
+            assert got == want
+
+        for_all(Gens.lists(range_deps_model(), max_size=4),
+                Gens.ints(0, 100), examples=100)(prop)
+
+    def test_slice_keeps_intersecting(self):
+        def prop(m, lo, width):
+            d = RangeDeps.of(m)
+            window = Ranges.of((lo, lo + width))
+            sliced = d.slice(window)
+            want_ids = set()
+            for r, v in m.items():
+                if r.start < lo + width and r.end > lo:
+                    want_ids.update(v)
+            got = set()
+            sliced.for_each_unique_txn_id(got.add)
+            assert got == want_ids
+
+        for_all(range_deps_model(), Gens.ints(0, 100), Gens.ints(1, 30),
+                examples=100)(prop)
+
+
+class TestDepsPair:
+    def test_merge_distributes_over_domains(self):
+        def prop(kmodels, rmodels):
+            n = max(len(kmodels), len(rmodels))
+            kmodels = kmodels + [{}] * (n - len(kmodels))
+            rmodels = rmodels + [{}] * (n - len(rmodels))
+            pairs = [Deps(KeyDeps.of(k), RangeDeps.of(r))
+                     for k, r in zip(kmodels, rmodels)]
+            merged = Deps.merge(pairs)
+            assert merged.key_deps == KeyDeps.merge(
+                [KeyDeps.of(k) for k in kmodels])
+            assert merged.range_deps == RangeDeps.merge(
+                [RangeDeps.of(r) for r in rmodels])
+
+        for_all(Gens.lists(key_deps_model(), max_size=3),
+                Gens.lists(range_deps_model(), max_size=3),
+                examples=80)(prop)
