@@ -134,8 +134,11 @@ class MaelstromRunner:
             hp.send({"src": "c0", "dest": name,
                      "body": {"type": "init", "msg_id": self._msg_seq,
                               "node_id": name, "node_ids": self.names}})
+        # cold-starting N python processes (each importing jax) contends for
+        # CPU; the deadline scales with cluster size
         ok = self.pump_until(
-            lambda: len(self.init_acks) == len(self.names), 30.0)
+            lambda: len(self.init_acks) == len(self.names),
+            30.0 + 15.0 * len(self.names))
         assert ok, f"init timed out: {sorted(self.init_acks)}"
 
     def submit_txn(self, client: str, ops: list, to: Optional[str] = None
@@ -154,9 +157,12 @@ class MaelstromRunner:
 
     # ------------------------------------------------------------ workload --
     def run_workload(self, n_ops: int = 40, n_keys: int = 8,
-                     pipeline: int = 4, deadline_s: float = 120.0) -> dict:
+                     pipeline: int = 4, deadline_s: float = 120.0,
+                     single_key: bool = False) -> dict:
         """Randomized append/read mix; returns counters. Appended values are
-        globally unique so the verifier can track per-key sequences."""
+        globally unique so the verifier can track per-key sequences.
+        `single_key` restricts every txn to one key (the lin-kv shape);
+        the default mixes multi-key RMWs (txn-rw-register)."""
         import random
         rng = random.Random(self.seed)
         next_value = [0]
@@ -169,7 +175,7 @@ class MaelstromRunner:
             if rng.random() < 0.7:
                 next_value[0] += 1
                 ops.append(["append", k, next_value[0]])
-            if rng.random() < 0.3:
+            if not single_key and rng.random() < 0.3:
                 k2 = rng.randrange(n_keys)
                 if not any(o == "append" and ok == k2 for o, ok, _ in ops):
                     next_value[0] += 1

@@ -306,6 +306,11 @@ PyObject *cintia_build(PyObject *, PyObject *args) {
     }
     c->n_offsets = ci;
     c->n_entries = e;
+    if (e < cap) {  /* shrink the doubling overshoot to fit */
+        long long *fit = (long long *)PyMem_Realloc(
+            c->entries, sizeof(long long) * (e ? e : 1));
+        if (fit != nullptr) c->entries = fit;
+    }
     PyObject *capsule = PyCapsule_New(c, "accord.cintia", cintia_destroy);
     if (capsule == nullptr) delete c;
     return capsule;

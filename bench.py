@@ -374,6 +374,43 @@ def bench_rangestress(n_ranges=1_000_000, n_txns=10_000, seed=42,
     }))
 
 
+# ------------------------------------------------------------ maelstrom ----
+
+def bench_maelstrom(nodes=3, keys=100, n_ops=400, single_key=True,
+                    seed=7):
+    """BASELINE rows 1-2: black-box throughput of the HOST protocol engine —
+    real OS-process nodes speaking the Maelstrom JSON wire format, real wall
+    clock, strict serializability verified post-run. Runs CPU-only (the host
+    tier never touches the chip)."""
+    from accord_tpu.host.runner import MaelstromRunner
+
+    r = MaelstromRunner(n_nodes=nodes, seed=seed)
+    try:
+        r.init_all()
+        t0 = time.perf_counter()
+        stats = r.run_workload(n_ops=n_ops, n_keys=keys, pipeline=16,
+                               single_key=single_key)
+        dt = time.perf_counter() - t0
+        checked = r.check_strict_serializability(keys)  # raises on violation
+    finally:
+        r.close()
+    assert checked > 0.9 * n_ops, (checked, stats)
+    assert stats["acked"] > 0.9 * n_ops, stats
+    shape = "lin-kv single-key" if single_key else "txn-rw multi-key RMW"
+    print(json.dumps({
+        "metric": "maelstrom_host_txn_per_sec",
+        "value": round(stats["acked"] / dt, 1),  # only verified-acked txns
+        "unit": "txn/s",
+        "workload": shape,
+        "nodes": nodes,
+        "keys": keys,
+        "ops": stats["completed"],
+        "acked": stats["acked"],
+        "wall_seconds": round(dt, 2),
+        "verified": "strict-serializable",
+    }))
+
+
 # ---------------------------------------------------------------- tpcc -----
 
 def _tpcc_resolve_fn():
@@ -500,7 +537,8 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="default",
-                    choices=["default", "zipf1m", "rangestress", "tpcc"])
+                    choices=["default", "zipf1m", "rangestress", "tpcc",
+                             "maelstrom", "maelstrom-rw"])
     ap.add_argument("--verify", action="store_true",
                     help="cross-check device window counts against a host "
                          "re-derivation (zipf1m)")
@@ -511,6 +549,10 @@ def main():
         bench_zipf1m(verify=ns.verify)
     elif ns.config == "tpcc":
         bench_tpcc()
+    elif ns.config == "maelstrom":
+        bench_maelstrom(nodes=3, keys=100, single_key=True)
+    elif ns.config == "maelstrom-rw":
+        bench_maelstrom(nodes=5, keys=20, single_key=False)
     else:
         bench_rangestress()
 
