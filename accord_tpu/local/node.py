@@ -247,6 +247,8 @@ class Node:
         if txn_id is None:
             txn_id = self.next_txn_id(txn.kind, domain)
         result = AsyncResult()
+        if self.trace.enabled:
+            self.trace.event("coordinate", txn_id=txn_id, kind=txn.kind.name)
         if txn.kind == TxnKind.EPHEMERAL_READ:
             # invisible single-round read: no recovery registration
             self.with_epoch(txn_id.epoch,
@@ -255,8 +257,6 @@ class Node:
             return result
         self.coordinating[txn_id] = result
         result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
-        if self.trace.enabled:
-            self.trace.event("coordinate", txn_id=txn_id, kind=txn.kind.name)
         self.with_epoch(txn_id.epoch,
                         lambda: CoordinateTransaction(self, txn_id, txn,
                                                       result).start())
