@@ -57,6 +57,27 @@ class _Probe:
         self.committed_versions = committed_versions
 
 
+class _RecoveryProbe:
+    """One precomputed recovery-scan set (the four mapReduceFull predicates
+    of BeginRecovery, ops/recovery_kernel.py) for one probe txn: per-key id
+    lists, servable over any subset of the covered keys.  Version gating is
+    EXACT (no self-bump tolerance): a first-witness registration inserts the
+    probe into other entries' missing[], which changes the scalar answers."""
+
+    __slots__ = ("txn_id", "rejects_a", "rejects_b", "witness", "no_witness",
+                 "key_set", "versions")
+
+    def __init__(self, txn_id: TxnId, rejects_a, rejects_b, witness,
+                 no_witness, key_set: Set[Key], versions: Dict[Key, int]):
+        self.txn_id = txn_id
+        self.rejects_a = rejects_a        # {key: [ids]} — any() => reject
+        self.rejects_b = rejects_b
+        self.witness = witness
+        self.no_witness = no_witness
+        self.key_set = key_set
+        self.versions = versions
+
+
 class DeviceSafeCommandStore(SafeCommandStore):
     def map_reduce_active(self, participants, before: Timestamp,
                           kinds: KindSet, fn, on_range_dep=None,
@@ -82,6 +103,106 @@ class DeviceSafeCommandStore(SafeCommandStore):
                     fn(key, dep)
         self._map_range_conflicts(owned, False, before, kinds, fn,
                                   on_range_dep)
+
+    # ---------------------------------------------- recovery scans (keys) --
+    def _recovery_servable(self, txn_id: TxnId, participants):
+        """The precomputed recovery probe and the owned KEY list, when every
+        queried key is covered and exactly at its snapshot version."""
+        store: DeviceCommandStore = self.store
+        probe = store._precomputed_recovery.get(txn_id)
+        if probe is None:
+            return None, None
+        owned = self._owned_participants(participants)
+        keys = (self._owned_cfk_keys(owned) if isinstance(owned, Ranges)
+                else list(owned))
+        for k in keys:
+            cfk = store.cfks.get(k)
+            v = cfk.version if cfk is not None else 0
+            if k not in probe.key_set or v != probe.versions.get(k, 0):
+                return None, None
+        return probe, keys
+
+    def _serve_recovery(self, which: str, txn_id: TxnId, participants,
+                        scalar_fn):
+        probe, keys = self._recovery_servable(txn_id, participants)
+        if probe is None:
+            self.store.device_recovery_misses += 1
+            return None
+        self.store.device_recovery_hits += 1
+        keyed = getattr(probe, which)
+        if self.store.verify:
+            want: Dict[Key, List[TxnId]] = {}
+            scalar_fn(want)
+            got = {k: keyed[k] for k in keys if keyed.get(k)}
+            want = {k: sorted(v) for k, v in want.items() if v}
+            if got != want:
+                err = AssertionError(
+                    f"device recovery scan '{which}' diverges for {txn_id}: "
+                    f"device={got} scalar={want}")
+                try:
+                    self.store.agent.on_uncaught_exception(err)
+                except Exception:
+                    pass
+                raise err
+        return {k: keyed[k] for k in keys if keyed.get(k)}
+
+    def _rejects_fast_path_keys(self, txn_id: TxnId, participants) -> bool:
+        def scalar_collect(out):
+            for cfk in self._participant_cfks(participants):
+                found = cfk.started_after_without_witnessing_ids(txn_id)
+                if found:
+                    out.setdefault(cfk.key, []).extend(found)
+
+        served_a = self._serve_recovery("rejects_a", txn_id, participants,
+                                        scalar_collect)
+        if served_a is None:
+            return super()._rejects_fast_path_keys(txn_id, participants)
+
+        def scalar_collect_b(out):
+            for cfk in self._participant_cfks(participants):
+                found = cfk.executes_after_without_witnessing_ids(txn_id)
+                if found:
+                    out.setdefault(cfk.key, []).extend(found)
+
+        served_b = self._serve_recovery("rejects_b", txn_id, participants,
+                                        scalar_collect_b)
+        if served_b is None:
+            return super()._rejects_fast_path_keys(txn_id, participants)
+        return bool(served_a) or bool(served_b)
+
+    def _earlier_committed_witness_keys(self, txn_id, participants,
+                                        builder) -> None:
+        def scalar_collect(out):
+            for cfk in self._participant_cfks(participants):
+                ids = cfk.stable_started_before_and_witnessed(txn_id)
+                if ids:
+                    out.setdefault(cfk.key, []).extend(ids)
+
+        served = self._serve_recovery("witness", txn_id, participants,
+                                      scalar_collect)
+        if served is None:
+            return super()._earlier_committed_witness_keys(
+                txn_id, participants, builder)
+        for k, ids in served.items():
+            for t in ids:
+                builder.add(k, t)
+
+    def _earlier_accepted_no_witness_keys(self, txn_id, participants,
+                                          builder) -> None:
+        def scalar_collect(out):
+            for cfk in self._participant_cfks(participants):
+                ids = cfk.accepted_started_before_without_witnessing(txn_id)
+                if ids:
+                    out.setdefault(cfk.key, []).extend(ids)
+
+        served = self._serve_recovery("no_witness", txn_id, participants,
+                                      scalar_collect)
+        if served is None:
+            return super()._earlier_accepted_no_witness_keys(
+                txn_id, participants, builder)
+        for k, ids in served.items():
+            for t in ids:
+                builder.add(k, t)
 
     def _version_ok(self, key: Key, probe: _Probe,
                     exclude: Optional[TxnId]) -> bool:
@@ -148,11 +269,14 @@ class DeviceCommandStore(CommandStore):
         self._window: List[Tuple[PreLoadContext, object, object]] = []
         self._flush_scheduled = False
         self._precomputed: Dict[Tuple[Timestamp, KindSet], _Probe] = {}
+        self._precomputed_recovery: Dict[TxnId, _RecoveryProbe] = {}
         self.device_hits = 0
         self.device_misses = 0
         self.device_batches = 0
         self.device_batched_probes = 0
         self.device_max_batch = 0
+        self.device_recovery_hits = 0
+        self.device_recovery_misses = 0
 
     @classmethod
     def factory(cls, flush_window_us: int = 0, verify: bool = False):
@@ -179,11 +303,13 @@ class DeviceCommandStore(CommandStore):
         if not window:
             return
         self._precompute(window)
+        self._precompute_recovery(window)
         try:
             for context, fn, result in window:
                 super()._submit(context, fn, result)
         finally:
             self._precomputed = {}
+            self._precomputed_recovery = {}
 
     def _precompute(self, window) -> None:
         probes: List[Tuple[Timestamp, KindSet, List[Key]]] = []
@@ -224,3 +350,43 @@ class DeviceCommandStore(CommandStore):
         for (before, kinds, ks), m in zip(probes, keyed):
             self._precomputed[(before, kinds)] = _Probe(
                 before, kinds, m, set(ks), versions, committed_versions)
+
+    def _precompute_recovery(self, window) -> None:
+        """Batch every declared recovery probe (BeginRecovery's four
+        mapReduceFull predicates) into one kernel call."""
+        self._precomputed_recovery = {}
+        probes: List[Tuple[TxnId, List[Key]]] = []
+        seen: Set[TxnId] = set()
+        for context, _fn, _result in window:
+            for txn_id, keys in context.recovery_probes:
+                if txn_id in seen:
+                    continue
+                owned = keys.slice(self.ranges) if not self.ranges.is_empty \
+                    else keys
+                if len(owned) == 0:
+                    continue
+                seen.add(txn_id)
+                probes.append((txn_id, list(owned)))
+        if not probes:
+            return
+
+        import numpy as _np
+
+        from accord_tpu.ops.recovery_kernel import (RecoveryEncoder,
+                                                    batched_recovery_scans)
+
+        touched = sorted({k for _, ks in probes for k in ks})
+        cfks = [self.cfks[k] for k in touched if k in self.cfks]
+        versions = {k: (self.cfks[k].version if k in self.cfks else 0)
+                    for k in touched}
+        enc = RecoveryEncoder(cfks, probes)
+        ra, rb, cw, anw = batched_recovery_scans(*enc.args())
+        ra, rb = _np.asarray(ra), _np.asarray(rb)
+        cw, anw = _np.asarray(cw), _np.asarray(anw)
+        self.device_batches += 1
+        self.device_batched_probes += len(probes)
+        for i, (txn_id, ks) in enumerate(probes):
+            self._precomputed_recovery[txn_id] = _RecoveryProbe(
+                txn_id, enc.decode_keyed(ra[i]), enc.decode_keyed(rb[i]),
+                enc.decode_keyed(cw[i]), enc.decode_keyed(anw[i]),
+                set(ks), versions)

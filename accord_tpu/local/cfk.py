@@ -490,26 +490,36 @@ class CommandsForKey:
                     continue
             fn(t, execute_at)
 
-    # the four BeginRecovery predicates (BeginRecovery.java:329-380)
-    def accepted_or_committed_started_after_without_witnessing(
-            self, txn_id: TxnId) -> bool:
-        found = []
+    # the four BeginRecovery predicates (BeginRecovery.java:329-380).
+    # The *_ids variants return the matching ids (the batched device store
+    # verifies its precomputed masks against them); the bool forms delegate.
+    def started_after_without_witnessing_ids(self, txn_id: TxnId
+                                             ) -> List[TxnId]:
+        found: List[TxnId] = []
         self.map_reduce_full(txn_id, txn_id.kind.witnessed_by(),
                              TestStartedAt.STARTED_AFTER, TestDep.WITHOUT,
                              TestStatus.IS_PROPOSED,
                              lambda t, at: found.append(t))
-        return bool(found)
+        return found
 
-    def committed_executes_after_without_witnessing(self, txn_id: TxnId
-                                                    ) -> bool:
+    def accepted_or_committed_started_after_without_witnessing(
+            self, txn_id: TxnId) -> bool:
+        return bool(self.started_after_without_witnessing_ids(txn_id))
+
+    def executes_after_without_witnessing_ids(self, txn_id: TxnId
+                                              ) -> List[TxnId]:
         """hasStableExecutesAfterWithoutWitnessing (ANY started-at; the dep
         test already restricts to executeAt > txn_id)."""
-        found = []
+        found: List[TxnId] = []
         self.map_reduce_full(txn_id, txn_id.kind.witnessed_by(),
                              TestStartedAt.ANY, TestDep.WITHOUT,
                              TestStatus.IS_STABLE,
                              lambda t, at: found.append(t))
-        return bool(found)
+        return found
+
+    def committed_executes_after_without_witnessing(self, txn_id: TxnId
+                                                    ) -> bool:
+        return bool(self.executes_after_without_witnessing_ids(txn_id))
 
     def stable_started_before_and_witnessed(self, txn_id: TxnId
                                             ) -> List[TxnId]:

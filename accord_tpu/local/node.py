@@ -379,8 +379,10 @@ class Node:
         (Node.mapReduceConsumeLocal :405 -> CommandStores.mapReduceConsume)."""
         participants = request.participants()
         probe = request.deps_probe()
+        rprobe = request.recovery_probe()
         context = PreLoadContext.for_txn(
-            request.txn_id, deps_probes=(probe,) if probe is not None else ())
+            request.txn_id, deps_probes=(probe,) if probe is not None else (),
+            recovery_probes=(rprobe,) if rprobe is not None else ())
         stores = self.command_stores.intersecting(participants)
         if not stores:
             if reply_context is not None:

@@ -39,13 +39,16 @@ class PreLoadContext:
     active-conflict scans the operation will run, letting a batched device
     store precompute them for the whole flush window in one kernel call."""
 
-    __slots__ = ("txn_ids", "keys", "deps_probes")
+    __slots__ = ("txn_ids", "keys", "deps_probes", "recovery_probes")
 
     def __init__(self, txn_ids: Sequence[TxnId] = (), keys=None,
-                 deps_probes: Sequence = ()):
+                 deps_probes: Sequence = (), recovery_probes: Sequence = ()):
         self.txn_ids = tuple(txn_ids)
         self.keys = keys if keys is not None else Keys(())
         self.deps_probes = tuple(deps_probes)
+        # (txn_id, Keys) of BeginRecovery's mapReduceFull predicate scans —
+        # the batched device store precomputes them per flush window
+        self.recovery_probes = tuple(recovery_probes)
 
     @classmethod
     def empty(cls) -> "PreLoadContext":
@@ -53,8 +56,9 @@ class PreLoadContext:
 
     @classmethod
     def for_txn(cls, txn_id: TxnId, keys=None,
-                deps_probes: Sequence = ()) -> "PreLoadContext":
-        return cls((txn_id,), keys, deps_probes)
+                deps_probes: Sequence = (),
+                recovery_probes: Sequence = ()) -> "PreLoadContext":
+        return cls((txn_id,), keys, deps_probes, recovery_probes)
 
 
 class SafeCommandStore:
@@ -283,13 +287,24 @@ class SafeCommandStore:
             if not overlap.is_empty:
                 yield cmd, overlap
 
+    # The recovery predicates split into a key tier (CommandsForKey scans —
+    # overridable by the batched device store) and a range tier (the
+    # range-command walk, always live).
+
     def rejects_fast_path(self, txn_id: TxnId, participants) -> bool:
-        wb = lambda t: self._witnessed_by(t, txn_id)
+        return self._rejects_fast_path_keys(txn_id, participants) \
+            or self._rejects_fast_path_ranges(txn_id, participants)
+
+    def _rejects_fast_path_keys(self, txn_id: TxnId, participants) -> bool:
         for cfk in self._participant_cfks(participants):
             if cfk.accepted_or_committed_started_after_without_witnessing(txn_id):
                 return True
             if cfk.committed_executes_after_without_witnessing(txn_id):
                 return True
+        return False
+
+    def _rejects_fast_path_ranges(self, txn_id: TxnId, participants) -> bool:
+        wb = lambda t: self._witnessed_by(t, txn_id)
         for cmd, _ in self._conflicting_range_cmds(txn_id, participants):
             if not cmd.txn_id.witnesses(txn_id) or wb(cmd.txn_id) \
                     or cmd.is_invalidated or cmd.is_truncated:
@@ -304,29 +319,49 @@ class SafeCommandStore:
     def earlier_committed_witness(self, txn_id: TxnId, participants) -> Deps:
         """Key/range-associated, so recovery can await on the dep's own shards
         (reference returns Deps, BeginRecovery.java:344)."""
-        from accord_tpu.primitives.deps import KeyDeps, RangeDeps
-        wb = lambda t: self._witnessed_by(t, txn_id)
+        from accord_tpu.primitives.deps import KeyDeps
         builder = KeyDeps.builder()
-        rbuilder = RangeDeps.builder()
+        self._earlier_committed_witness_keys(txn_id, participants, builder)
+        return Deps(builder.build(),
+                    self._earlier_committed_witness_ranges(txn_id,
+                                                           participants))
+
+    def _earlier_committed_witness_keys(self, txn_id, participants,
+                                        builder) -> None:
         for cfk in self._participant_cfks(participants):
             for t in cfk.stable_started_before_and_witnessed(txn_id):
                 builder.add(cfk.key, t)
+
+    def _earlier_committed_witness_ranges(self, txn_id, participants):
+        from accord_tpu.primitives.deps import RangeDeps
+        wb = lambda t: self._witnessed_by(t, txn_id)
+        rbuilder = RangeDeps.builder()
         for cmd, overlap in self._conflicting_range_cmds(txn_id, participants):
             if cmd.txn_id < txn_id and cmd.has_been(SaveStatus.STABLE) \
                     and not cmd.is_invalidated and not cmd.is_truncated \
                     and wb(cmd.txn_id):
                 for r in overlap:
                     rbuilder.add(r, cmd.txn_id)
-        return Deps(builder.build(), rbuilder.build())
+        return rbuilder.build()
 
     def earlier_accepted_no_witness(self, txn_id: TxnId, participants) -> Deps:
-        from accord_tpu.primitives.deps import KeyDeps, RangeDeps
-        wb = lambda t: self._witnessed_by(t, txn_id)
+        from accord_tpu.primitives.deps import KeyDeps
         builder = KeyDeps.builder()
-        rbuilder = RangeDeps.builder()
+        self._earlier_accepted_no_witness_keys(txn_id, participants, builder)
+        return Deps(builder.build(),
+                    self._earlier_accepted_no_witness_ranges(txn_id,
+                                                             participants))
+
+    def _earlier_accepted_no_witness_keys(self, txn_id, participants,
+                                          builder) -> None:
         for cfk in self._participant_cfks(participants):
             for t in cfk.accepted_started_before_without_witnessing(txn_id):
                 builder.add(cfk.key, t)
+
+    def _earlier_accepted_no_witness_ranges(self, txn_id, participants):
+        from accord_tpu.primitives.deps import RangeDeps
+        wb = lambda t: self._witnessed_by(t, txn_id)
+        rbuilder = RangeDeps.builder()
         for cmd, overlap in self._conflicting_range_cmds(txn_id, participants):
             if cmd.txn_id < txn_id \
                     and cmd.save_status == SaveStatus.ACCEPTED \
@@ -336,7 +371,7 @@ class SafeCommandStore:
                     and not wb(cmd.txn_id):
                 for r in overlap:
                     rbuilder.add(r, cmd.txn_id)
-        return Deps(builder.build(), rbuilder.build())
+        return rbuilder.build()
 
 
 class CommandStore:

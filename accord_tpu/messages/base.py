@@ -155,6 +155,13 @@ class TxnRequest(Request):
         window's deps in one kernel call (PreLoadContext.deps_probes)."""
         return None
 
+    def recovery_probe(self):
+        """(txn_id, data Keys) of the recovery predicate scans apply() will
+        run (the four mapReduceFull queries of BeginRecovery), or None —
+        the batched device store precomputes them per flush window
+        (PreLoadContext.recovery_probes, ops/recovery_kernel.py)."""
+        return None
+
 
 class SimpleReply(Reply):
     type = MessageType.SIMPLE_RSP

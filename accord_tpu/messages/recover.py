@@ -160,6 +160,15 @@ class BeginRecovery(TxnRequest):
             return self.scope.ranges
         return self.scope.participant_keys()
 
+    def recovery_probe(self):
+        from accord_tpu.primitives.keys import Keys
+        if self.partial_txn is not None \
+                and isinstance(self.partial_txn.keys, Keys):
+            return (self.txn_id, self.partial_txn.keys)
+        if self.scope.is_key_domain:
+            return (self.txn_id, self.scope.participant_keys())
+        return None  # range-domain recovery: the key tier has no probe
+
     def reduce(self, a: Reply, b: Reply) -> Reply:
         if isinstance(a, RecoverNack):
             return a

@@ -1,0 +1,189 @@
+"""Batched recovery scans — north-star kernel #3.
+
+The reference's recovery voting round computes four per-key predicates per
+BeginRecovery, each a full scan of the conflict index testing the missing[]
+divergence encoding (CommandsForKey.mapReduceFull,
+reference accord/local/CommandsForKey.java:553-612, driven by
+messages/BeginRecovery.java:104-190):
+
+  * rejects-fast-path (a): an ACCEPTED/COMMITTED txn started after ours,
+    proposed to execute after us, whose deps omit us;
+  * rejects-fast-path (b): a STABLE/APPLIED txn executing after us whose
+    deps omit us;
+  * earlier-committed-witness: stable txns started before us that DID
+    witness us;
+  * earlier-accepted-no-witness: proposed txns started before us, executing
+    after us, whose deps omit us (recovery must await their commit).
+
+Device formulation: all four share one [B, E] mask algebra over the rank
+encoding.  The missing[] membership test — the scalar scan's inner bisect —
+collapses to ONE searchsorted: each (entry, missing-id) pair is encoded as
+`entry_index * R + missing_rank` into a single sorted vector, and probe b's
+membership at entry e is a binary-search hit for `e * R + rank(b)`.  The
+per-key "is the probe witnessed here" gate of WITH-dep queries rides the MXU
+as an equality-presence matmul.  Outputs are two [B] booleans and two [B, E]
+masks, bit-identical to the scalar predicates (tests/test_recovery_kernel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from accord_tpu.ops.encode import _pad_to
+from accord_tpu.primitives.keys import Key
+from accord_tpu.primitives.timestamp import TxnId
+
+# InternalStatus bands (accord_tpu.local.cfk.InternalStatus)
+_ACCEPTED = 3
+_COMMITTED = 4
+_STABLE = 5
+_APPLIED = 6
+
+
+@functools.partial(jax.jit, static_argnames=())
+def batched_recovery_scans(entry_rank: jax.Array, entry_eat_rank: jax.Array,
+                           entry_key: jax.Array, entry_status: jax.Array,
+                           entry_kind: jax.Array, missing_code: jax.Array,
+                           probe_rank: jax.Array, probe_wb_mask: jax.Array,
+                           touches: jax.Array, rank_count: int):
+    """-> four [B, E] masks (rejects-started-after, rejects-executes-after,
+    committed-witness, accepted-no-witness).  Callers fold the reject masks
+    with any(); keeping them per-entry lets a serving store answer over any
+    SUBSET of a probe's keys."""
+    touch_e = jnp.take(touches, entry_key, axis=1)               # [B, E]
+    valid = entry_rank >= 0
+    not_self = entry_rank[None, :] != probe_rank[:, None]
+    witnessed_kind = ((probe_wb_mask[:, None] >> entry_kind[None, :]) & 1) == 1
+    proposed = (entry_status == _ACCEPTED) | (entry_status == _COMMITTED)
+    stable_band = (entry_status >= _STABLE) & (entry_status <= _APPLIED)
+    has_info = (entry_status >= _ACCEPTED) & (entry_status <= _APPLIED)
+    eat_gt = entry_eat_rank[None, :] > probe_rank[:, None]
+
+    # missing[] membership: one searchsorted over the coded pairs (the
+    # encoder guarantees missing_code is non-empty — sentinel -1 pad — and
+    # that codes fit int32)
+    codes = (jnp.arange(entry_rank.shape[0], dtype=jnp.int32)[None, :]
+             * rank_count + probe_rank[:, None])                  # [B, E]
+    idx = jnp.searchsorted(missing_code, codes.reshape(-1))
+    idx = jnp.clip(idx, 0, missing_code.shape[0] - 1)
+    hit = jnp.take(missing_code, idx) == codes.reshape(-1)
+    in_missing = hit.reshape(codes.shape)                         # [B, E]
+
+    # probe known at entry's key: presence matmul over (rank==, key) pairs
+    k = touches.shape[1]
+    eqm = (entry_rank[None, :] == probe_rank[:, None]) & valid[None, :]
+    onehot_key = (entry_key[:, None]
+                  == jnp.arange(k)[None, :]).astype(jnp.float32)  # [E, K]
+    known_at_key = (jnp.dot(eqm.astype(jnp.float32), onehot_key,
+                            preferred_element_type=jnp.float32) > 0)  # [B, K]
+    known = jnp.take_along_axis(
+        known_at_key, jnp.broadcast_to(entry_key[None, :], codes.shape),
+        axis=1)                                                   # [B, E]
+
+    dep_without = has_info[None, :] & eat_gt & in_missing
+    dep_with = has_info[None, :] & eat_gt & ~in_missing & known
+
+    started_before = entry_rank[None, :] < probe_rank[:, None]
+    started_after = entry_rank[None, :] > probe_rank[:, None]
+    base = touch_e & not_self & witnessed_kind & valid[None, :] \
+        & (probe_rank >= 0)[:, None]
+
+    rejects_a = base & started_after & proposed[None, :] & dep_without
+    rejects_b = base & stable_band[None, :] & dep_without
+    committed_witness = base & started_before & stable_band[None, :] & dep_with
+    accepted_no_witness = base & started_before & proposed[None, :] \
+        & dep_without
+    return rejects_a, rejects_b, committed_witness, accepted_no_witness
+
+
+class RecoveryEncoder:
+    """Encodes CFK state + a batch of recovery probes for the kernel.
+
+    Reuses the rank-universe discipline of ops/encode.py: every TxnId and
+    executeAt is mapped to a dense rank; missing[] collections become the
+    sorted coded vector `entry_index * R + missing_rank`."""
+
+    def __init__(self, cfks, probes: Sequence[Tuple[TxnId, Sequence[Key]]],
+                 pad: int = 128):
+        self.probes = list(probes)
+        self.keys: List[Key] = sorted({c.key for c in cfks}
+                                      | {k for _, ks in probes for k in ks})
+        self.key_index = {key: i for i, key in enumerate(self.keys)}
+        ts = set(tid for tid, _ in probes)
+        entries = []
+        missing_lists = []
+        for cfk in cfks:
+            ki = self.key_index[cfk.key]
+            ids, statuses, eats, missing = cfk.as_arrays()
+            for tid, status, eat, m in zip(ids, statuses, eats, missing):
+                ts.add(tid)
+                ts.add(eat)
+                ts.update(m)
+                entries.append((ki, tid, int(status), eat))
+                missing_lists.append(m)
+        self.universe = sorted(ts)
+        self.rank = {t: i for i, t in enumerate(self.universe)}
+        self.rank_count = max(1, len(self.universe))
+        self.entries = entries
+
+        e = _pad_to(max(1, len(entries)), pad)
+        self.entry_rank = np.full(e, -1, np.int32)
+        self.entry_eat_rank = np.full(e, -1, np.int32)
+        self.entry_key = np.zeros(e, np.int32)
+        self.entry_status = np.full(e, 7, np.int32)  # INVALID_OR_TRUNCATED
+        self.entry_kind = np.zeros(e, np.int32)
+        # codes must fit int32 (jax defaults to 32-bit): entry_index * R +
+        # rank.  Worlds beyond ~2^31 pairs stay on the scalar path.
+        assert e * self.rank_count < (1 << 31), \
+            "recovery-scan world too large for int32 codes"
+        codes: List[int] = []
+        for i, ((ki, tid, status, eat), m) in enumerate(
+                zip(entries, missing_lists)):
+            self.entry_rank[i] = self.rank[tid]
+            self.entry_eat_rank[i] = self.rank[eat]
+            self.entry_key[i] = ki
+            self.entry_status[i] = status
+            self.entry_kind[i] = int(tid.kind)
+            for mid in m:
+                codes.append(i * self.rank_count + self.rank[mid])
+        codes.sort()
+        # sentinel -1 keeps the array non-empty; probe codes are >= 0
+        self.missing_code = np.asarray([-1] + codes, np.int32)
+
+        b = _pad_to(max(1, len(probes)), pad)
+        kpad = _pad_to(max(1, len(self.keys)), pad)
+        self.probe_rank = np.full(b, -1, np.int32)
+        self.probe_wb_mask = np.zeros(b, np.int32)
+        self.touches = np.zeros((b, kpad), bool)
+        for i, (tid, ks) in enumerate(probes):
+            self.probe_rank[i] = self.rank[tid]
+            mask = 0
+            for kk in tid.kind.witnessed_by():
+                mask |= 1 << int(kk)
+            self.probe_wb_mask[i] = mask
+            for key in ks:
+                self.touches[i, self.key_index[key]] = True
+
+    def args(self):
+        return (self.entry_rank, self.entry_eat_rank, self.entry_key,
+                self.entry_status, self.entry_kind, self.missing_code,
+                self.probe_rank, self.probe_wb_mask, self.touches,
+                self.rank_count)
+
+    def decode_ids(self, mask_row: np.ndarray) -> List[TxnId]:
+        """One probe's [E] mask -> sorted unique TxnIds."""
+        return sorted({self.entries[e][1]
+                       for e in np.nonzero(mask_row[:len(self.entries)])[0]})
+
+    def decode_keyed(self, mask_row: np.ndarray) -> Dict[Key, List[TxnId]]:
+        """One probe's [E] mask -> {key: sorted ids} (for per-key serving)."""
+        out: Dict[Key, List[TxnId]] = {}
+        for e in np.nonzero(mask_row[:len(self.entries)])[0]:
+            ki, tid, _status, _eat = self.entries[e]
+            out.setdefault(self.keys[ki], []).append(tid)
+        return {k: sorted(v) for k, v in out.items()}
