@@ -30,6 +30,8 @@ path (tests/test_ops.py).
 
 from accord_tpu.ops.encode import BatchEncoder, DeviceState, DeviceBatch
 from accord_tpu.ops.deps_kernel import batched_active_deps, in_batch_graph
+from accord_tpu.ops.recovery_kernel import (RecoveryEncoder,
+                                            batched_recovery_scans)
 from accord_tpu.ops.wavefront import execution_waves, waves_oracle
 from accord_tpu.ops.sharded import make_sharded_step, resolve_step
 
@@ -41,6 +43,7 @@ _PALLAS_EXPORTS = ("batched_active_deps_pallas", "execution_waves_pallas",
 __all__ = [
     "BatchEncoder", "DeviceState", "DeviceBatch",
     "batched_active_deps", "in_batch_graph",
+    "RecoveryEncoder", "batched_recovery_scans",
     "execution_waves", "waves_oracle",
     "make_sharded_step", "resolve_step",
 ]

@@ -300,7 +300,7 @@ def main(argv=None) -> int:
                     print(dump)
         extra = ""
         if args.device_store:
-            h = m = b = p = 0
+            h = m = b = p = rh = rm = 0
             mx = 0
             for node in run.cluster.nodes.values():
                 for s in node.command_stores.all():
@@ -309,8 +309,11 @@ def main(argv=None) -> int:
                     b += s.device_batches
                     p += s.device_batched_probes
                     mx = max(mx, s.device_max_batch)
+                    rh += s.device_recovery_hits
+                    rm += s.device_recovery_misses
             extra = (f" device[hits={h} misses={m} batches={b} "
-                     f"probes={p} max_batch={mx}]")
+                     f"probes={p} max_batch={mx} "
+                     f"recovery_hits={rh} recovery_misses={rm}]")
         print(f"seed={seed} ops={args.ops} {stats} "
               f"virtual_time={run.cluster.now_s:.1f}s "
               f"events={run.cluster.queue.processed} OK{extra}")
