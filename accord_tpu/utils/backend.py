@@ -1,0 +1,46 @@
+"""Backend probing/forcing for the tunneled TPU platform.
+
+The ambient environment selects a tunneled TPU PJRT plugin (JAX_PLATFORMS).
+When the tunnel drops, backend resolution blocks FOREVER — and the env var
+alone does not prevent it: only `jax.config.update("jax_platforms", "cpu")`
+does (tests/conftest.py does the same dance).  This module is the one shared
+copy of both moves:
+
+* `force_cpu()` — pin this process to the CPU backend, robust to the dead
+  tunnel;
+* `resolve_platform(timeout)` — probe device init in a subprocess with a
+  timeout; on failure force CPU and return an honest label for output.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backends already initialised
+        pass
+
+
+def resolve_platform(probe_timeout_s: float = 90.0) -> str:
+    """Probe the ambient backend; on an unreachable device platform, force
+    CPU and return a fallback label. Call before the first jax use."""
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if platform == "cpu":
+        return "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            capture_output=True, timeout=probe_timeout_s, text=True)
+        if out.returncode == 0 and "ok" in out.stdout:
+            return platform or "default"
+    except subprocess.TimeoutExpired:
+        pass
+    force_cpu()
+    return f"cpu-fallback({platform or 'default'} unreachable)"

@@ -30,6 +30,9 @@ import time
 import numpy as np
 
 
+PLATFORM = "unprobed"  # set by main() for device-using configs
+
+
 def build_world(n_keys=1024, n_existing=65536, n_batch=512, seed=42,
                 zipf_alpha=0.99):
     from accord_tpu.local.cfk import CommandsForKey, InternalStatus
@@ -123,6 +126,7 @@ def bench_default():
         "value": round(device_eps, 1),
         "unit": "edges/s",
         "vs_baseline": round(device_eps / scalar_eps, 2),
+        "platform": PLATFORM,
     }))
 
 
@@ -313,6 +317,7 @@ def bench_zipf1m(verify=False):
         "metric": "zipf1m_edges_resolved_per_sec",
         "value": round(edges / dt, 1),
         "unit": "edges/s",
+        "platform": PLATFORM,
         "edges": edges,
         "txns": txns,
         "windows": len(windows),
@@ -366,6 +371,7 @@ def bench_rangestress(n_ranges=1_000_000, n_txns=10_000, seed=42,
         "metric": "rangestress_edges_resolved_per_sec",
         "value": round(edges / dt, 1),
         "unit": "edges/s",
+        "platform": PLATFORM,
         "edges": edges,
         "txns": n_txns,
         "txns_per_sec": round(n_txns / dt, 1),
@@ -521,6 +527,7 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
         "metric": "tpcc_neworder_resolve_ms",
         "value": round(dt * 1e3, 2),
         "unit": "ms",
+        "platform": PLATFORM,
         "target_ms": 50.0,
         "hardware": "1 chip (target stated for v5e-8)",
         "txns": n_txns,
@@ -535,6 +542,7 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
 
 
 def main():
+    global PLATFORM
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="default",
                     choices=["default", "zipf1m", "rangestress", "tpcc",
@@ -543,6 +551,11 @@ def main():
                     help="cross-check device window counts against a host "
                          "re-derivation (zipf1m)")
     ns = ap.parse_args()
+    if ns.config not in ("maelstrom", "maelstrom-rw"):
+        # device-using configs probe the (possibly dead-tunneled) backend
+        # first; host-only configs never touch the chip
+        from accord_tpu.utils.backend import resolve_platform
+        PLATFORM = resolve_platform()
     if ns.config == "default":
         bench_default()
     elif ns.config == "zipf1m":
