@@ -101,3 +101,20 @@ class TestBurnUnderFaults:
                       syncpoint_unmerged_deps=True):
             stats = BurnRun(seed=64, ops=120, drop_prob=0.05).run()
         assert stats.acks > 0
+
+    def test_burn_all_faults_on_device_store(self):
+        """The batched device tier must stay bit-identical to the scalar
+        path even under the protocol-weakening faults (deps omit conflicts
+        the key gates then catch) — verify=True cross-checks every served
+        scan inline."""
+        from accord_tpu.impl.device_store import DeviceCommandStore
+        factory = DeviceCommandStore.factory(flush_window_us=200, verify=True)
+        with injected(transaction_instability=True,
+                      transaction_unmerged_deps=True):
+            run = BurnRun(seed=65, ops=100, drop_prob=0.05,
+                          store_factory=factory)
+            stats = run.run()
+        assert stats.acks > 0
+        hits = sum(s.device_hits for node in run.cluster.nodes.values()
+                   for s in node.command_stores.all())
+        assert hits > 0
