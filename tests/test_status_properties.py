@@ -1,0 +1,95 @@
+"""Randomized lattice laws for the Known/SaveStatus knowledge model.
+
+Reference model: Status.java's Known vector with atLeast/reduce/merge —
+SURVEY flags this lattice and its truncation interactions as the most
+invariant-dense code in the tree, so its algebra gets property coverage:
+merge is a join (commutative, associative, idempotent, monotone), satisfies
+is the lattice order, and every SaveStatus maps to a Known consistent with
+its phase.
+"""
+
+from accord_tpu.local.status import (Known, KnownDefinition, KnownDeps,
+                                     KnownExecuteAt, KnownOutcome, KnownRoute,
+                                     Phase, SaveStatus)
+from accord_tpu.utils.property import Gens, for_all
+
+
+def known_gen():
+    return Gens.tuples(
+        Gens.ints(0, len(KnownRoute) - 1),
+        Gens.ints(0, len(KnownDefinition) - 1),
+        Gens.ints(0, len(KnownExecuteAt) - 1),
+        Gens.ints(0, len(KnownDeps) - 1),
+        Gens.ints(0, len(KnownOutcome) - 1),
+    ).map(lambda t: Known(KnownRoute(t[0]), KnownDefinition(t[1]),
+                          KnownExecuteAt(t[2]), KnownDeps(t[3]),
+                          KnownOutcome(t[4])))
+
+
+class TestKnownLattice:
+    def test_merge_is_a_join(self):
+        def prop(a, b, c):
+            ab = a.merge(b)
+            assert ab == b.merge(a)                       # commutative
+            assert ab.merge(c) == a.merge(b.merge(c))     # associative
+            assert a.merge(a) == a                        # idempotent
+            assert ab.satisfies(a) and ab.satisfies(b)    # upper bound
+            assert a.merge(Known.NOTHING) == a            # identity
+
+        for_all(known_gen(), known_gen(), known_gen(), examples=200)(prop)
+
+    def test_satisfies_is_the_lattice_order(self):
+        def prop(a, b):
+            ab = a.merge(b)
+            # least upper bound: anything satisfying both satisfies merge
+            assert not (a.satisfies(b) and b.satisfies(a)) or a == b
+            for x in (a, b):
+                assert ab.satisfies(x)
+            if a.satisfies(b):
+                assert a.merge(b) == a
+
+        for_all(known_gen(), known_gen(), examples=200)(prop)
+
+    def test_satisfies_reflexive_transitive(self):
+        def prop(a, b, c):
+            assert a.satisfies(a)
+            if a.satisfies(b) and b.satisfies(c):
+                assert a.satisfies(c)
+            assert a.satisfies(Known.NOTHING)
+
+        for_all(known_gen(), known_gen(), known_gen(), examples=200)(prop)
+
+
+class TestSaveStatusKnown:
+    def test_every_status_maps_consistently(self):
+        for st in SaveStatus:
+            k = st.known()
+            assert isinstance(k, Known)
+            if st.is_at_least_stable and not st.is_truncated \
+                    and not st.is_invalidated:
+                assert k.deps >= KnownDeps.STABLE, st
+                assert k.execute_at >= KnownExecuteAt.YES, st
+            if st == SaveStatus.INVALIDATED:
+                assert k.is_invalidated
+            if st.is_at_least_committed and not st.is_truncated \
+                    and not st.is_invalidated:
+                assert k.execute_at >= KnownExecuteAt.YES, st
+
+    def test_known_monotone_along_normal_progression(self):
+        """Knowledge never shrinks along the normal (untruncated) status
+        ladder: each next status satisfies everything the previous knew."""
+        ladder = [SaveStatus.PRE_ACCEPTED, SaveStatus.ACCEPTED,
+                  SaveStatus.COMMITTED, SaveStatus.STABLE,
+                  SaveStatus.READY_TO_EXECUTE, SaveStatus.PRE_APPLIED,
+                  SaveStatus.APPLYING, SaveStatus.APPLIED]
+        for prev, nxt in zip(ladder, ladder[1:]):
+            assert nxt.known().satisfies(prev.known()), (prev, nxt)
+
+    def test_phase_monotone_on_ladder(self):
+        ladder = [SaveStatus.NOT_DEFINED, SaveStatus.PRE_ACCEPTED,
+                  SaveStatus.ACCEPTED, SaveStatus.COMMITTED,
+                  SaveStatus.STABLE, SaveStatus.PRE_APPLIED,
+                  SaveStatus.APPLIED]
+        phases = [st.phase for st in ladder]
+        assert phases == sorted(phases)
+        assert phases[0] == Phase.NONE
