@@ -1,0 +1,102 @@
+"""Quorum-intersection safety laws for the Shard arithmetic.
+
+Reference model: Shard.java:38-96 and the Accord paper's intersection
+requirements.  These are THE safety-bearing inequalities of the protocol —
+checked exhaustively over every (rf, electorate size) configuration up to
+rf = 9, plus set-level witnesses that the sizes actually force the
+intersections they promise:
+
+  1. two slow-path quorums intersect (Paxos-style);
+  2. a slow-path quorum survives maxFailures failures;
+  3. two fast-path quorums of the electorate intersect;
+  4. after ANY maxFailures replicas fail, a recovery coordinator reaching a
+     slow quorum sees at least recoveryFastPathSize surviving members of
+     every possible fast-path quorum — enough electorate evidence to decide
+     whether the fast path could have committed (Shard.java's
+     recoveryFastPathSize/rejectsFastPath arithmetic).
+"""
+
+from itertools import combinations
+
+import pytest
+
+from accord_tpu.topology.shard import (Shard, fast_path_quorum_size,
+                                       max_tolerated_failures,
+                                       slow_path_quorum_size)
+from accord_tpu.primitives.keys import Range
+
+
+def configs(max_rf=9):
+    for rf in range(1, max_rf + 1):
+        f = max_tolerated_failures(rf)
+        for e in range(rf - f, rf + 1):
+            yield rf, e, f
+
+
+def test_size_inequalities_exhaustive():
+    for rf, e, f, in configs():
+        slow = slow_path_quorum_size(rf)
+        fast = fast_path_quorum_size(rf, e, f)
+        rec = (f + 1) // 2
+        assert 1 <= slow <= rf
+        assert 2 * slow > rf                      # slow quorums intersect
+        assert fast <= e                          # fast path is achievable
+        assert 2 * fast > e                       # fast quorums intersect
+        assert slow + f <= rf + f                 # slow reachable under f failures
+        assert rf - f >= slow or rf == 1          # survivors can form slow quorum
+        # the recovery-visibility law: a slow quorum excludes exactly
+        # rf - slow replicas (failed ones included — it is drawn from the
+        # survivors), so it always contains >= fast - (rf - slow) members
+        # of any fast quorum; that floor must reach recoveryFastPathSize
+        # or recovery could miss the fast decision
+        assert fast - (rf - slow) >= rec, (rf, e, f)
+
+
+@pytest.mark.parametrize("rf,e,f", [(rf, e, f) for rf, e, f in configs(7)])
+def test_intersection_witnesses_set_level(rf, e, f):
+    """Brute-force the promised intersections on actual node sets."""
+    nodes = tuple(range(1, rf + 1))
+    electorate = frozenset(nodes[:e])
+    shard = Shard(Range(0, 10), nodes, electorate)
+    slow, fast = shard.slow_path_quorum_size, shard.fast_path_quorum_size
+    rec = shard.recovery_fast_path_size
+
+    for q1 in combinations(nodes, slow):
+        for q2 in combinations(nodes, slow):
+            assert set(q1) & set(q2), "slow quorums must intersect"
+
+    el = sorted(electorate)
+    for fq1 in combinations(el, fast):
+        for fq2 in combinations(el, fast):
+            assert set(fq1) & set(fq2), "fast quorums must intersect"
+
+    # recovery visibility: for every fast quorum and every failure set of
+    # size f and every slow quorum among survivors, the slow quorum sees
+    # >= rec members of the fast quorum
+    if rf <= 5:  # keep the triple product bounded
+        for fq in combinations(el, fast):
+            for failed in combinations(nodes, f):
+                survivors = [n for n in nodes if n not in failed]
+                if len(survivors) < slow:
+                    continue
+                for sq in combinations(survivors, slow):
+                    seen = set(sq) & set(fq)
+                    assert len(seen) >= rec, (fq, failed, sq)
+
+
+def test_rejects_fast_path_boundary():
+    """rejects_fast_path flips exactly when the remaining electorate can no
+    longer reach the fast quorum."""
+    for rf, e, f in configs(7):
+        shard = Shard(Range(0, 10), tuple(range(rf)),
+                      frozenset(range(e)))
+        fast = shard.fast_path_quorum_size
+        for rejects in range(e + 1):
+            possible = (e - rejects) >= fast
+            assert shard.rejects_fast_path(rejects) == (not possible), \
+                (rf, e, rejects)
+
+
+def test_electorate_minimum_enforced():
+    with pytest.raises(Exception):
+        fast_path_quorum_size(5, 2, 2)  # e < rf - f
