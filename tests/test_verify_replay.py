@@ -85,3 +85,39 @@ class TestWitnessReplay:
                                    WitnessReplayVerifier())
             c2.observe(Observation("t1", {}, {1: 10}, 0, 5))
             c2.verify({1: ()})
+
+
+class TestRealTimeReduction:
+    def test_reduced_edges_preserve_reachability(self):
+        """The suffix-min-end reduction (shared by both checkers) must keep
+        the transitive closure identical to the full O(n^2) ended-before-
+        started relation."""
+        import random
+
+        from accord_tpu.sim.verify import real_time_edges
+
+        rng = random.Random(11)
+        for trial in range(30):
+            n = rng.randint(0, 18)
+            obs = []
+            for i in range(n):
+                s = rng.randint(0, 50)
+                obs.append(Observation(f"t{i}", {}, {}, s,
+                                       s + rng.randint(1, 30)))
+            reduced = {i: set() for i in range(n)}
+            real_time_edges(obs, lambda a, b: reduced[a].add(b))
+            # transitive closure of the reduced graph
+            reach = {i: set(reduced[i]) for i in range(n)}
+            changed = True
+            while changed:
+                changed = False
+                for a in range(n):
+                    for b in list(reach[a]):
+                        new = reach[b] - reach[a]
+                        if new:
+                            reach[a] |= new
+                            changed = True
+            for a in range(n):
+                for b in range(n):
+                    if a != b and obs[a].end_us < obs[b].start_us:
+                        assert b in reach[a], (trial, a, b)
