@@ -45,6 +45,21 @@ def node_num(name: str) -> int:
     return (zlib.crc32(name.encode()) % 1_000_000) + 1_000
 
 
+def build_topology(ids, rf: int, n_shards: int) -> Topology:
+    """Static epoch-1 topology over the token span: `n_shards` even ranges,
+    replicas rotated over the sorted node ids (shared by every host
+    transport so deployments cannot diverge on shard boundaries)."""
+    ids = sorted(ids)
+    width = TOKEN_SPAN // n_shards
+    shards = []
+    for i in range(n_shards):
+        start = i * width
+        end = TOKEN_SPAN if i == n_shards - 1 else (i + 1) * width
+        replicas = [ids[(i + j) % len(ids)] for j in range(rf)]
+        shards.append(Shard(Range(start, end), replicas))
+    return Topology(1, shards)
+
+
 def key_token(k) -> int:
     if isinstance(k, bool) or not isinstance(k, int):
         return zlib.crc32(str(k).encode()) % TOKEN_SPAN
@@ -121,14 +136,7 @@ class MaelstromHost:
         ids = sorted(node_num(n) for n in node_names)
         self.names = {node_num(n): n for n in node_names}
         rf = self.rf if self.rf is not None else min(3, len(ids))
-        width = TOKEN_SPAN // len(ids)
-        shards = []
-        for i in range(len(ids)):
-            start = i * width
-            end = TOKEN_SPAN if i == len(ids) - 1 else (i + 1) * width
-            replicas = [ids[(i + j) % len(ids)] for j in range(rf)]
-            shards.append(Shard(Range(start, end), replicas))
-        topology = Topology(1, shards)
+        topology = build_topology(ids, rf, n_shards=len(ids))
         agent = HostAgent()
         self.scheduler.on_error = agent.on_uncaught_exception
         self.node = Node(my_id, self.sink, agent, self.scheduler,

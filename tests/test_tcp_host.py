@@ -1,0 +1,59 @@
+"""TCP transport host: Accord over real sockets on localhost.
+
+The distributed communication backend made concrete (SURVEY §5.8): three
+nodes, each with its own listening socket and single-threaded core, commit
+list-register transactions over length-prefixed wire-codec frames; the
+histories are checked strictly serializable by the burn verifier.
+"""
+
+import pytest
+
+from accord_tpu.host.tcp import TcpHost
+from accord_tpu.sim.verify import Observation, StrictSerializabilityVerifier
+
+
+@pytest.mark.slow
+def test_three_node_tcp_cluster_strict_serializable():
+    ports = {1: ("127.0.0.1", 0), 2: ("127.0.0.1", 0), 3: ("127.0.0.1", 0)}
+    # first host binds its own port; feed realised addresses to the rest
+    hosts = {}
+    try:
+        hosts[1] = TcpHost(1, ports)
+        ports = dict(hosts[1].peers)
+        hosts[2] = TcpHost(2, ports)
+        ports = dict(hosts[2].peers)
+        hosts[3] = TcpHost(3, ports)
+        ports = dict(hosts[3].peers)
+        # realised ports must be consistent everywhere
+        for h in hosts.values():
+            h.peers.update(ports)
+
+        verifier = StrictSerializabilityVerifier()
+        value = 0
+        import time
+        for i in range(30):
+            h = hosts[1 + i % 3]
+            token = 10 + (i % 4)
+            value += 1
+            start = int(time.monotonic() * 1e6)
+            res = h.submit([token], {token: value}).wait(30.0)
+            end = int(time.monotonic() * 1e6)
+            assert res.failure is None, res.failure
+            reads = dict(res.value.read_values) if res.value is not None \
+                else {}
+            verifier.observe(Observation(
+                f"txn{i}@n{h.my_id}",
+                {k.token: tuple(v) for k, v in reads.items()},
+                {token: value}, start, end))
+
+        # final histories via a read-only txn per token
+        final = {}
+        for token in (10, 11, 12, 13):
+            res = hosts[2].submit([token], {}).wait(30.0)
+            assert res.failure is None, res.failure
+            vals = dict(res.value.read_values)
+            final[token] = tuple(next(iter(vals.values())))
+        verifier.verify(final)
+    finally:
+        for h in hosts.values():
+            h.close()
