@@ -60,7 +60,11 @@ class Invalidate(Callback):
         self.failure: Optional[BaseException] = None
 
     def start(self) -> None:
-        topologies = self.node.topology.with_unsynced_epochs(
+        # precisely the txnId epoch (reference Invalidate.java:76 forEpoch):
+        # like recovery, the fast-path vote math must consult exactly the
+        # electorate that could have ratified the fast path, not an
+        # unsynced-extended older epoch's
+        topologies = self.node.topology.precise_epochs(
             self.invalidate_with.participants(), self.txn_id.epoch,
             self.txn_id.epoch)
         self.tracker = InvalidationTracker(topologies)

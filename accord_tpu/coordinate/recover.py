@@ -51,7 +51,14 @@ class Recover(Callback):
 
     # ------------------------------------------------------- recovery round --
     def start(self) -> None:
-        topologies = self.node.topology.with_unsynced_epochs(
+        # PRECISELY the txnId epoch (reference Recover.java:163 asserts
+        # oldestEpoch == currentEpoch == txnId.epoch, via forEpoch): the
+        # unsynced-extension would pull OLDER epochs' electorates into the
+        # fast-path vote math, and a non-witness there can veto a fast path
+        # that was never required to consult that electorate — recovery then
+        # invalidates a committed transaction (found by a 2000-op soak burn
+        # under loss + topology churn).
+        topologies = self.node.topology.precise_epochs(
             self.route.participants(), self.txn_id.epoch, self.txn_id.epoch)
         self.tracker = RecoveryTracker(topologies)
         for to in topologies.nodes():
@@ -146,8 +153,42 @@ class Recover(Callback):
             self.txn_id)
         return merged.partial_txn.reconstitute(self.route)
 
+    def _require_definition(self, merged: RecoverOk, cont) -> bool:
+        """Completion paths need the txn body, but the recovery quorum may
+        hold only definition-less knowledge (Accept carries keys, not the
+        txn; Propagate can install PreCommitted without it).  Fetch it from
+        whoever has it; if nobody reachable does, retreat — the progress
+        log retries once partitions heal.  Returns True when the
+        continuation was taken over (deferred or failed)."""
+        if merged.partial_txn is not None:
+            return False
+        from accord_tpu.coordinate.fetch import fetch_data
+
+        def fetched(ok, failure):
+            if self.done:
+                return
+            pt = getattr(ok, "partial_txn", None) if failure is None else None
+            # a slice that does not cover the route must NOT be promoted to
+            # the whole txn — completing with it would silently drop other
+            # shards' reads/updates; retreat and retry when more knowledge
+            # is reachable
+            if pt is not None and pt.covers(self.route.covering()):
+                merged.partial_txn = pt
+                cont()
+            else:
+                self._fail(Exhausted(
+                    f"recovery of {self.txn_id} could not obtain a "
+                    f"route-covering txn definition from any reachable "
+                    f"replica"))
+
+        fetch_data(self.node, self.txn_id, self.route).add_callback(fetched)
+        return True
+
     def _propose(self, merged: RecoverOk, execute_at: Timestamp, deps: Deps
                  ) -> None:
+        if self._require_definition(
+                merged, lambda: self._propose(merged, execute_at, deps)):
+            return
         txn = self._reconstitute(merged)
 
         def accepted(stable_deps: Deps):
@@ -168,6 +209,9 @@ class Recover(Callback):
                  txn: Optional[Txn] = None) -> None:
         if self.done:
             return
+        if txn is None and self._require_definition(
+                merged, lambda: self._execute(merged, execute_at, deps)):
+            return
         txn = txn if txn is not None else self._reconstitute(merged)
         path = ExecutePath(self.node, self.txn_id, txn, self.route, execute_at,
                            deps, CommitKind.STABLE_MAXIMAL, ApplyKind.MAXIMAL,
@@ -179,6 +223,9 @@ class Recover(Callback):
     def _persist_outcome(self, merged: RecoverOk) -> None:
         """Outcome already known: re-broadcast Apply.Maximal
         (Recover.java Applied/PreApplied arm)."""
+        if self._require_definition(
+                merged, lambda: self._persist_outcome(merged)):
+            return
         txn = self._reconstitute(merged)
 
         # replicas store writes with `keys` sliced to their ranges but the

@@ -24,8 +24,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
-from accord_tpu.api.spi import CallbackSink
-from accord_tpu.host.maelstrom import HostAgent, build_topology
+from accord_tpu.host.maelstrom import (HostAgent, MaelstromSink,
+                                       build_topology)
 from accord_tpu.host.rt import RealTimeScheduler
 from accord_tpu.host.wire import decode_message, encode_message
 from accord_tpu.impl.list_store import ListQuery, ListRead, ListStore, ListUpdate
@@ -61,24 +61,11 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-class TcpSink(CallbackSink):
-    def __init__(self, host: "TcpHost"):
-        super().__init__()
-        self.host = host
-
-    def send(self, to: int, request) -> None:
-        self.host.emit(to, {"type": "accord",
-                            "payload": encode_message(request)})
-
-    def send_with_callback(self, to: int, request, callback,
-                           executor=None) -> None:
-        msg_id = self._register(callback)
-        self.host.emit(to, {"type": "accord", "msg_id": msg_id,
-                            "payload": encode_message(request)})
-
-    def reply(self, to: int, reply_context, reply) -> None:
-        self.host.emit(to, {"type": "accord", "in_reply_to": reply_context,
-                            "payload": encode_message(reply)})
+# TcpSink IS MaelstromSink: both write {"type": "accord", ...} bodies to a
+# host exposing emit_node(to, body); only the transport underneath differs.
+# One implementation keeps the framing (and the None-reply_context guard)
+# from ever diverging between transports.
+TcpSink = MaelstromSink
 
 
 class SubmitResult:
@@ -194,30 +181,38 @@ class TcpHost:
                              daemon=True).start()
 
     def _reader(self, conn: socket.socket) -> None:
-        while self.running:
+        try:
+            while self.running:
+                frame = _recv_frame(conn)  # raises on corrupt bytes
+                if frame is None:
+                    return  # clean EOF
+                self.inbox.put(("frame", frame))
+        except (OSError, ValueError, UnicodeDecodeError):
+            return  # corrupt stream / peer reset: drop the connection
+        finally:
             try:
-                frame = _recv_frame(conn)
-            except (OSError, ValueError, UnicodeDecodeError):
-                # a corrupt frame poisons the whole byte stream: close it so
-                # the sender reconnects rather than writing into a void
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                return
-            if frame is None:
-                return
-            self.inbox.put(("frame", frame))
+                conn.close()
+            except OSError:
+                pass
 
     def emit(self, to: int, body: dict) -> None:
         """Enqueue onto the peer's writer thread — the loop thread must
         never block on connect/send (a blackholed peer would stall every
-        timer and dispatch for the connect timeout)."""
+        timer and dispatch for the connect timeout). Self-addressed frames
+        skip the loopback round trip entirely."""
+        frame = {"src": self.my_id, "body": body}
+        if to == self.my_id:
+            self.inbox.put(("frame", frame))
+            return
         with self._out_lock:
             writer = self._out.get(to)
             if writer is None:
                 writer = self._out[to] = _PeerWriter(self, to)
-        writer.enqueue({"src": self.my_id, "body": body})
+        writer.enqueue(frame)
+
+    # MaelstromSink's transport hook (shared sink implementation)
+    def emit_node(self, to: int, body: dict) -> None:
+        self.emit(to, body)
 
     # ---------------------------------------------------------------- loop --
     def _run(self) -> None:
@@ -255,14 +250,19 @@ class TcpHost:
         result = SubmitResult()
 
         def run():
-            keys = Keys.of(*(set(read_tokens) | set(appends)))
-            txn = Txn(
-                TxnKind.WRITE if appends else TxnKind.READ, keys,
-                read=ListRead(Keys.of(*read_tokens)) if read_tokens else None,
-                query=ListQuery(),
-                update=ListUpdate({Key(t): v for t, v in appends.items()})
-                if appends else None)
-            self.node.coordinate(txn).add_callback(result._complete)
+            try:
+                keys = Keys.of(*(set(read_tokens) | set(appends)))
+                txn = Txn(
+                    TxnKind.WRITE if appends else TxnKind.READ, keys,
+                    read=ListRead(Keys.of(*read_tokens))
+                    if read_tokens else None,
+                    query=ListQuery(),
+                    update=ListUpdate({Key(t): v
+                                       for t, v in appends.items()})
+                    if appends else None)
+                self.node.coordinate(txn).add_callback(result._complete)
+            except BaseException as e:  # noqa: BLE001 — the client must see
+                result._complete(None, e)  # the real error, not a timeout
 
         self.inbox.put(("call", run))
         return result

@@ -76,7 +76,14 @@ class CheckStatusOk(Reply):
             max(self.durability, other.durability),
             route,
             self.is_coordinating or other.is_coordinating,
-            hi.partial_txn if hi.partial_txn is not None else lo.partial_txn,
+            # UNION the definitions (RecoverOk.merge does the same):
+            # replicas hold slices of the txn body; keeping just one side
+            # could later reconstitute a partial body as the whole txn and
+            # silently drop other shards' reads/updates
+            (hi.partial_txn.with_(lo.partial_txn)
+             if hi.partial_txn is not None and lo.partial_txn is not None
+             else hi.partial_txn if hi.partial_txn is not None
+             else lo.partial_txn),
             hi.stable_deps if hi.stable_deps is not None else lo.stable_deps,
             hi.writes if hi.writes is not None else lo.writes,
             hi.result if hi.result is not None else lo.result,

@@ -48,6 +48,39 @@ def test_burn_flagship_scale():
     assert run.partition_nemesis.partitions_applied > 0
 
 
+def test_burn_regression_recovery_epoch_pinning():
+    """Seed 1234 under loss + partitions + drift + churn once invalidated a
+    fast-path-committed txn: the recovery tracker was built over
+    unsynced-extended epochs, so an OLDER epoch's electorate member that
+    never witnessed the txn vetoed a fast path that was ratified by the
+    txn-epoch electorate alone. Recovery/invalidation now pin their vote
+    math to precisely txnId.epoch (reference Recover.java:163). The failure
+    fired at virtual ~27s, well inside this 400-op prefix of the original
+    2000-op soak."""
+    from accord_tpu.sim.delayed_store import DelayedCommandStore
+    from accord_tpu.utils.random_source import RandomSource
+    run = BurnRun(1234, 400, drop_prob=0.08, partitions=True,
+                  clock_drift=True, num_command_stores=2,
+                  store_factory=DelayedCommandStore.factory(
+                      RandomSource(0x5D5D ^ 1234)))
+    stats = run.run()
+    assert stats.lost == 0 and stats.pending == 0
+
+
+def test_burn_regression_recovery_fetches_definition():
+    """Seed 4321: recovery reached a completion path holding only
+    definition-less knowledge (Accept carries keys, not the txn body) and
+    crashed; it now fetches the definition or retreats for a later retry."""
+    from accord_tpu.sim.delayed_store import DelayedCommandStore
+    from accord_tpu.utils.random_source import RandomSource
+    run = BurnRun(4321, 500, drop_prob=0.1, partitions=True,
+                  clock_drift=True, num_command_stores=2,
+                  store_factory=DelayedCommandStore.factory(
+                      RandomSource(0x5D5D ^ 4321)))
+    stats = run.run()
+    assert stats.lost == 0 and stats.pending == 0
+
+
 def test_burn_hostile_device_store():
     from accord_tpu.impl.device_store import DeviceCommandStore
     run = BurnRun(31, 60, drop_prob=0.1, partitions=True, clock_drift=True,
