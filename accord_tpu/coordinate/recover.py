@@ -171,8 +171,10 @@ class Recover(Callback):
             # a slice that does not cover the route must NOT be promoted to
             # the whole txn — completing with it would silently drop other
             # shards' reads/updates; retreat and retry when more knowledge
-            # is reachable
-            if pt is not None and pt.covers(self.route.covering()):
+            # is reachable.  For key-domain routes the definitive test is
+            # key-set containment (the route lists exactly the txn's
+            # participating keys; PartialTxn.covers is range-only).
+            if pt is not None and self._definition_covers_route(pt):
                 merged.partial_txn = pt
                 cont()
             else:
@@ -183,6 +185,13 @@ class Recover(Callback):
 
         fetch_data(self.node, self.txn_id, self.route).add_callback(fetched)
         return True
+
+    def _definition_covers_route(self, pt) -> bool:
+        from accord_tpu.primitives.keys import Keys
+        if self.route.is_key_domain and isinstance(pt.keys, Keys):
+            want = set(self.route.participant_keys())
+            return want <= set(pt.keys)
+        return pt.covers(self.route.covering())
 
     def _propose(self, merged: RecoverOk, execute_at: Timestamp, deps: Deps
                  ) -> None:

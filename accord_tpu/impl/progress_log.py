@@ -20,6 +20,11 @@ from accord_tpu.local.status import ProgressToken, SaveStatus
 from accord_tpu.primitives.keys import Route
 from accord_tpu.primitives.timestamp import TxnId
 
+# escalation backoff cap: attempts space retries out linearly, but repair
+# latency after a long partition must stay bounded — a chain of
+# dependency fetches otherwise takes (attempts x grace) per link to heal
+_MAX_BACKOFF_STEPS = 8
+
 
 class _HomeState:
     """Progress tracking for a txn this store is home for
@@ -142,7 +147,8 @@ class SimpleProgressLog(ProgressLog):
     def _check_home(self, state: _HomeState, now: float) -> None:
         if state.investigating:
             return
-        deadline = state.updated_at_s + self._grace_s * (1 + state.attempts)
+        deadline = state.updated_at_s \
+            + self._grace_s * (1 + min(state.attempts, _MAX_BACKOFF_STEPS))
         if now < deadline:
             return
         if state.route is None:
@@ -198,7 +204,8 @@ class SimpleProgressLog(ProgressLog):
         if cmd is not None and _blocked_satisfied(cmd, state):
             self.blocked.pop(state.txn_id, None)
             return
-        deadline = state.since_s + self._grace_s * (1 + state.attempts)
+        deadline = state.since_s \
+            + self._grace_s * (1 + min(state.attempts, _MAX_BACKOFF_STEPS))
         if now < deadline:
             return
         # a runnable command that merely missed its notification needs a

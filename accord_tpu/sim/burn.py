@@ -198,9 +198,18 @@ class BurnRun:
             self.nemesis.stop()
         if self.partition_nemesis is not None:
             self.partition_nemesis.stop()
-        cluster.queue.drain(
-            until_us=cluster.queue.clock.now_us + 60_000_000,
-            max_items=5_000_000)
+        # drain trailing replication, then — because acked work may still be
+        # repairing (Apply loss after long partitions; the progress-log
+        # chase heals it but needs virtual time) — keep draining while
+        # unapplied decided commands remain, up to a hard cap.  A REAL
+        # protocol read would wait on these via deps, so verifying a raw
+        # snapshot earlier would be a harness false alarm.
+        for _ in range(11):
+            cluster.queue.drain(
+                until_us=cluster.queue.clock.now_us + 60_000_000,
+                max_items=5_000_000)
+            if not self._has_unapplied_decided():
+                break
         self.stats.pending = inflight[0]
         tally = (self.stats.acks + self.stats.nacks + self.stats.lost
                  + self.stats.pending)
@@ -220,6 +229,19 @@ class BurnRun:
             self.journal_checked, self.journal_skipped = \
                 validate_cluster(self.cluster)
         return self.stats
+
+    def _has_unapplied_decided(self) -> bool:
+        """Any stable-or-outcome-holding command still waiting to execute?"""
+        from accord_tpu.local.status import SaveStatus
+        for node in self.cluster.nodes.values():
+            for store in node.command_stores.all():
+                for cmd in store.commands.values():
+                    if cmd.save_status in (SaveStatus.STABLE,
+                                           SaveStatus.READY_TO_EXECUTE,
+                                           SaveStatus.PRE_APPLIED,
+                                           SaveStatus.APPLYING):
+                        return True
+        return False
 
     def _final_histories(self) -> Dict[int, Tuple[int, ...]]:
         """Longest agreed history per key across replicas (replicas may lag
