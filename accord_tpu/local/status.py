@@ -109,8 +109,12 @@ class SaveStatus(enum.IntEnum):
         if self == SaveStatus.INVALIDATED:
             return Known.INVALIDATED
         if self.is_truncated:
+            # decision reached but deps cleaned up: ERASED, not NO — so a
+            # per-range knowledge reduce over a truncated source degrades
+            # below STABLE instead of masquerading as decided deps
             return Known(KnownRoute.MAYBE, KnownDefinition.NO,
-                         KnownExecuteAt.YES, KnownDeps.NO, KnownOutcome.APPLY)
+                         KnownExecuteAt.YES, KnownDeps.ERASED,
+                         KnownOutcome.APPLY)
         route = KnownRoute.FULL
         definition = (KnownDefinition.YES if self.is_defined else KnownDefinition.NO)
         if self >= SaveStatus.PRE_APPLIED:
@@ -234,11 +238,16 @@ class KnownExecuteAt(enum.IntEnum):
 
 
 class KnownDeps(enum.IntEnum):
+    """Reference Status.KnownDeps:539 order: ERASED (deps cleaned up by
+    truncation) sorts BELOW STABLE so min-style reduces degrade a
+    stable∧erased mix to not-stable, while NO (invalidated — deps never
+    needed) sorts above everything."""
     UNKNOWN = 0
     PROPOSED = 1
     COMMITTED = 2
-    STABLE = 3
-    NO = 4          # invalidated
+    ERASED = 3      # decision reached, deps cleaned up (DepsErased)
+    STABLE = 4
+    NO = 5          # invalidated (NoDeps)
 
 
 class KnownOutcome(enum.IntEnum):
@@ -273,6 +282,26 @@ class Known:
                      max(self.outcome, other.outcome))
 
     merge = at_least
+
+    def reduce(self, other: "Known") -> "Known":
+        """The knowledge valid across BOTH sources' ranges (reference
+        Status.Known.reduce:171): per-range facts — the definition body and
+        the dependency set — take the minimum, because each range only knows
+        what its own replica reported; global facts — executeAt and the
+        outcome — take the maximum, because deciding either anywhere decides
+        it everywhere; and the route is FULL only if some source held the
+        full route (a COVERING route covers only its own ranges)."""
+        if self.route == other.route:
+            route = self.route
+        elif KnownRoute.FULL in (self.route, other.route):
+            route = KnownRoute.FULL
+        else:
+            route = KnownRoute.MAYBE
+        return Known(route,
+                     min(self.definition, other.definition),
+                     max(self.execute_at, other.execute_at),
+                     min(self.deps, other.deps),
+                     max(self.outcome, other.outcome))
 
     def satisfies(self, required: "Known") -> bool:
         return (self.route >= required.route

@@ -60,11 +60,13 @@ class Apply(TxnRequest):
         deps = self.deps
         if deps is not None and not safe_store.ranges.is_empty:
             deps = deps.slice(safe_store.ranges)
-        writes = self.writes
-        if writes is not None and not safe_store.ranges.is_empty:
-            writes = writes.slice(safe_store.ranges)
+        # store the FULL writes (reference keeps command.writes() unsliced;
+        # execution slices per store via Writes.apply(within)): outcome
+        # knowledge is then legitimately global — any replica that knows the
+        # outcome can hand every store the whole effect, so CheckStatus
+        # merges need no per-range writes provenance
         outcome = C.apply(safe_store, self.txn_id, self.route, self.execute_at,
-                          deps, writes, self.result,
+                          deps, self.writes, self.result,
                           partial_txn=self.partial_txn)
         return ApplyReply({
             C.ApplyOutcome.SUCCESS: ApplyReply.APPLIED,

@@ -11,19 +11,86 @@ from __future__ import annotations
 import enum
 from typing import Optional
 
-from accord_tpu.local.status import Durability, ProgressToken, SaveStatus
+from accord_tpu.local.status import Durability, Known, ProgressToken, SaveStatus
 from accord_tpu.messages.base import MessageType, Reply, TxnRequest
 from accord_tpu.primitives.deps import Deps
-from accord_tpu.primitives.keys import Route
+from accord_tpu.primitives.keys import Range, Ranges, Route
 from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
 from accord_tpu.primitives.txn import PartialTxn
 from accord_tpu.primitives.writes import Writes
+from accord_tpu.utils.interval_map import ReducingRangeMap
 
 
 class IncludeInfo(enum.Enum):
     NO = "No"
     ROUTE = "Route"
     ALL = "All"
+
+
+def _token_spans(participants):
+    """[(start, end)) token spans of a Keys/RoutingKeys or Ranges selection."""
+    if isinstance(participants, Range):
+        return [(participants.start, participants.end)]
+    if not isinstance(participants, Ranges):
+        participants = participants.to_ranges()
+    return [(r.start, r.end) for r in participants]
+
+
+class KnownMap:
+    """Per-range knowledge provenance (reference CheckStatus.FoundKnownMap:
+    298): which Known vector is justified over which token spans. Each
+    replying replica builds one over the participants its store actually
+    covers; merging replies takes the range-wise at_least; consumers ask
+    known_for(owned) — Known.reduce across every owned span, with
+    Known.NOTHING standing in for any uncovered gap — so a partial-quorum
+    merge cannot overclaim per-range knowledge (definition, deps) for shards
+    that never replied, while still crediting global facts (executeAt,
+    outcome) decided anywhere (FoundKnownMap.knownFor)."""
+
+    __slots__ = ("_map",)
+
+    EMPTY: "KnownMap"
+
+    def __init__(self, _map: Optional[ReducingRangeMap] = None):
+        self._map = _map if _map is not None else ReducingRangeMap()
+
+    @classmethod
+    def create(cls, participants, known: Known) -> "KnownMap":
+        m = ReducingRangeMap()
+        for s, e in _token_spans(participants):
+            m = m.update(s, e, known, Known.at_least)
+        return cls(m)
+
+    def merge(self, other: "KnownMap") -> "KnownMap":
+        return KnownMap(self._map.merge(other._map, Known.at_least))
+
+    def known_for(self, participants) -> Known:
+        """The Known vector valid across ALL the given participants."""
+        def f(acc, v):
+            k = v if v is not None else Known.NOTHING
+            return k if acc is None else acc.reduce(k)
+
+        acc = None
+        for s, e in _token_spans(participants):
+            acc = self._map.fold_intersecting(s, e, f, acc)
+        return acc if acc is not None else Known.NOTHING
+
+    def known_for_any(self) -> Known:
+        """The at_least union over every span (FoundKnownMap.knownForAny)."""
+        acc = Known.NOTHING
+        for _s, _e, v in self._map.spans():
+            if v is not None:
+                acc = acc.at_least(v)
+        return acc
+
+    def __eq__(self, other):
+        return isinstance(other, KnownMap) and self._map == other._map
+
+    def __repr__(self):
+        return f"KnownMap({self._map!r})"
+
+
+KnownMap.EMPTY = KnownMap()
 
 
 class CheckStatusOk(Reply):
@@ -39,7 +106,8 @@ class CheckStatusOk(Reply):
                  partial_txn: Optional[PartialTxn] = None,
                  stable_deps: Optional[Deps] = None,
                  writes: Optional[Writes] = None, result=None,
-                 invalid_if_undecided: bool = False):
+                 invalid_if_undecided: bool = False,
+                 known_map: Optional[KnownMap] = None):
         self.save_status = save_status
         self.promised = promised
         self.accepted = accepted
@@ -56,6 +124,9 @@ class CheckStatusOk(Reply):
         # ballot-backed Invalidate round — NOT a licence to invalidate
         # without one (see infer.py's safety note)
         self.invalid_if_undecided = invalid_if_undecided
+        # per-range knowledge provenance; None only for legacy/hand-built
+        # replies, in which case known_for falls back to the global vector
+        self.known_map = known_map
 
     def merge(self, other: "CheckStatusOk") -> "CheckStatusOk":
         """Field-wise maximum knowledge (CheckStatusOk.merge)."""
@@ -84,12 +155,38 @@ class CheckStatusOk(Reply):
              if hi.partial_txn is not None and lo.partial_txn is not None
              else hi.partial_txn if hi.partial_txn is not None
              else lo.partial_txn),
-            hi.stable_deps if hi.stable_deps is not None else lo.stable_deps,
-            hi.writes if hi.writes is not None else lo.writes,
+            # UNION the stable deps too (CheckStatusOkFull.merge:820-822
+            # `fullMax.stableDeps.with(fullMin.stableDeps)`): each STABLE
+            # replica holds the deps slice for ITS ranges only; keeping one
+            # side would leave the known_map claiming deps-STABLE over
+            # ranges whose actual deps were on the discarded side
+            (hi.stable_deps.with_(lo.stable_deps)
+             if hi.stable_deps is not None and lo.stable_deps is not None
+             else hi.stable_deps if hi.stable_deps is not None
+             else lo.stable_deps),
+            # reunite writes: commands now store the FULL writes (Apply no
+            # longer slices at store time), but replies from older partial
+            # applications or hand-built sources may still carry slices —
+            # the union is correct either way and costs one keys merge
+            (hi.writes.merge(lo.writes) if hi.writes is not None
+             else lo.writes),
             hi.result if hi.result is not None else lo.result,
             invalid_if_undecided=(self.invalid_if_undecided
                                   or other.invalid_if_undecided),
+            known_map=(None if self.known_map is None
+                       and other.known_map is None
+                       else (self.known_map or KnownMap.EMPTY).merge(
+                           other.known_map or KnownMap.EMPTY)),
         )
+
+    def known_for(self, participants) -> Known:
+        """The Known vector justified across ALL the given participants —
+        Propagate's gate for per-store application (CheckStatusOk via
+        FoundKnownMap.knownFor). Falls back to the global projection for
+        hand-built replies with no provenance map."""
+        if self.known_map is None:
+            return self.save_status.known()
+        return self.known_map.known_for(participants)
 
     def to_progress_token(self) -> ProgressToken:
         """Progress summary for liveness comparisons
@@ -123,10 +220,16 @@ class CheckStatus(TxnRequest):
         undecided = cmd is None or not cmd.save_status.is_decided
         proof = (undecided and invalid_if_undecided(
             safe_store, self.txn_id, self.scope.participants()))
+        # provenance: this store's knowledge applies only to the scope slice
+        # its ranges actually cover (FoundKnownMap.create over command-store
+        # ranges, CheckStatus.java:326)
+        owned = self.scope.owned_participants(safe_store.ranges)
         if cmd is None:
             return CheckStatusOk(SaveStatus.NOT_DEFINED, Ballot.ZERO,
                                  Ballot.ZERO, None, Durability.NOT_DURABLE,
-                                 None, invalid_if_undecided=proof)
+                                 None, invalid_if_undecided=proof,
+                                 known_map=KnownMap.create(owned,
+                                                           Known.NOTHING))
         full = self.include_info == IncludeInfo.ALL
         return CheckStatusOk(
             cmd.save_status, cmd.promised, cmd.accepted_ballot,
@@ -137,7 +240,8 @@ class CheckStatus(TxnRequest):
             stable_deps=cmd.stable_deps if full else None,
             writes=cmd.writes if full else None,
             result=cmd.result if full else None,
-            invalid_if_undecided=proof)
+            invalid_if_undecided=proof,
+            known_map=KnownMap.create(owned, cmd.save_status.known()))
 
     def reduce(self, a: Reply, b: Reply) -> Reply:
         if isinstance(a, CheckStatusNack):

@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Optional
 
 from accord_tpu.local import commands as C
-from accord_tpu.local.status import SaveStatus
+from accord_tpu.local.status import KnownDefinition, KnownDeps, SaveStatus
 from accord_tpu.messages.base import MessageType, Reply, SimpleReply, TxnRequest
 from accord_tpu.messages.checkstatus import CheckStatusOk
 from accord_tpu.primitives.keys import Route
@@ -44,29 +44,48 @@ class Propagate(TxnRequest):
             # learn here (Infer territory)
             return SimpleReply(SimpleReply.OK)
 
+        # what the merged reply actually justifies for THIS store's slice of
+        # the route (CheckStatus.FoundKnownMap.knownFor): a partial-quorum
+        # merge may carry a high global save_status whose definition/deps
+        # fields cover only the shards that replied — slicing those to our
+        # ranges would silently yield under-covering deps/bodies, so each
+        # per-range tier below also requires the per-range knowledge
+        owned = route.owned_participants(safe_store.ranges)
+        knows = k.known_for(owned)
+
         local = k.partial_txn.slice(safe_store.ranges, include_query=False) \
             if k.partial_txn is not None and not safe_store.ranges.is_empty \
             else k.partial_txn
         deps = k.stable_deps.slice(safe_store.ranges) \
             if k.stable_deps is not None and not safe_store.ranges.is_empty \
             else k.stable_deps
+        if knows.deps < KnownDeps.STABLE:
+            # not justified for every owned range: let each tier's
+            # deps-required path degrade (apply falls to INSUFFICIENT
+            # catch-up + staleness escalation, commit tiers are skipped)
+            deps = None
+        if knows.definition < KnownDefinition.YES:
+            local = None
 
         if k.save_status >= SaveStatus.PRE_APPLIED and k.writes is not None \
                 and k.execute_at is not None:
             outcome = C.apply(safe_store, self.txn_id, route, k.execute_at,
                               deps, k.writes, k.result, partial_txn=local)
-            if outcome == C.ApplyOutcome.INSUFFICIENT:
-                # truncated-with-outcome source (deps purged) and we are
-                # below STABLE: per-txn catch-up cannot order this write
-                # safely — applying here with fabricated deps could reorder
-                # writes under the data plane's executeAt guard. After
-                # repeated failures, declare the owning ranges stale and
-                # re-acquire them wholesale (reference markShardStale ->
-                # bootstrap; ADVICE r1: nothing else triggers bootstrap
-                # outside topology changes, so the replica wedged forever).
-                self._maybe_escalate_staleness(safe_store, route)
-            else:
+            if outcome != C.ApplyOutcome.INSUFFICIENT:
                 safe_store.store.insufficient_catchups.pop(self.txn_id, None)
+            elif knows.deps == KnownDeps.ERASED:
+                # truncated-with-outcome source (deps purged, gone forever)
+                # and we are below STABLE: per-txn catch-up cannot order
+                # this write safely — applying here with fabricated deps
+                # could reorder writes under the data plane's executeAt
+                # guard. After repeated failures, declare the owning ranges
+                # stale and re-acquire them wholesale (reference
+                # markShardStale -> bootstrap; ADVICE r1: nothing else
+                # triggers bootstrap outside topology changes, so the
+                # replica wedged forever).
+                self._maybe_escalate_staleness(safe_store, route)
+            # else: deps merely unfetched (partial quorum, partition) — a
+            # later fetch can still supply them, so no escalation strike
             return SimpleReply(SimpleReply.OK)
         if k.save_status >= SaveStatus.STABLE and k.execute_at is not None \
                 and deps is not None and not cmd.has_been(SaveStatus.STABLE):

@@ -409,6 +409,14 @@ class Route:
             return Route(self.home_key, keys=self.keys.slice(ranges), is_full=False)
         return Route(self.home_key, ranges=self.ranges.slice(ranges), is_full=False)
 
+    def owned_participants(self, ranges: Ranges):
+        """Participants falling within a store's owned `ranges`; the full
+        participant set for an unbounded (empty-ranges) store. The shared
+        idiom for 'what slice of this route does this store answer for'."""
+        if ranges.is_empty:
+            return self.participants()
+        return self.slice(ranges).participants()
+
     def with_(self, other: "Route") -> "Route":
         invariants.check_argument(other.home_key == self.home_key, "home key mismatch")
         if self.keys is not None:

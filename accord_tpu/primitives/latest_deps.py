@@ -125,7 +125,7 @@ class LatestDeps:
         sufficient: List[Range] = []
         for s, e, v in self._spans():
             rng = Ranges([Range(s, e)])
-            if v.known >= KnownDeps.COMMITTED and v.known != KnownDeps.NO:
+            if v.known in (KnownDeps.COMMITTED, KnownDeps.STABLE):
                 if v.coordinated is not None:
                     parts.append(v.coordinated.slice(rng))
                     sufficient.append(Range(s, e))

@@ -10,11 +10,15 @@ import pytest
 from accord_tpu.coordinate.fetch import (check_shards, fetch_data, find_route,
                                          maybe_recover)
 from accord_tpu.impl.list_store import ListQuery, ListRead, ListUpdate
-from accord_tpu.local.status import Durability, SaveStatus
+from accord_tpu.local.status import (Durability, Known, KnownDefinition,
+                                     KnownDeps, KnownExecuteAt, KnownRoute,
+                                     SaveStatus)
 from accord_tpu.messages.apply_msg import Apply
-from accord_tpu.messages.checkstatus import CheckStatusOk, IncludeInfo
-from accord_tpu.primitives.keys import Key, Keys, Ranges
-from accord_tpu.primitives.timestamp import Ballot, TxnKind
+from accord_tpu.messages.checkstatus import (CheckStatus, CheckStatusOk,
+                                             IncludeInfo, KnownMap)
+from accord_tpu.primitives.deps import Deps, KeyDeps
+from accord_tpu.primitives.keys import Key, Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import Ballot, Domain, TxnId, TxnKind
 from accord_tpu.primitives.txn import Txn
 from accord_tpu.sim.burn import BurnRun
 from accord_tpu.sim.cluster import SimCluster
@@ -52,6 +56,147 @@ class TestCheckStatusMergge:
         assert m.save_status == SaveStatus.STABLE
         m2 = b.merge(a)
         assert m2.save_status == SaveStatus.STABLE
+
+    def test_merge_unions_stable_deps(self):
+        """Each STABLE replica holds the deps slice for its own ranges;
+        merge must union them (CheckStatusOkFull.merge:820-822), not keep
+        one side."""
+        d1 = TxnId.create(1, 100, TxnKind.WRITE, Domain.KEY, 1)
+        d2 = TxnId.create(1, 101, TxnKind.WRITE, Domain.KEY, 2)
+        a = CheckStatusOk(SaveStatus.STABLE, Ballot.ZERO, Ballot.ZERO,
+                          None, Durability.NOT_DURABLE, None,
+                          stable_deps=Deps(KeyDeps.of({Key(5): {d1}})))
+        b = CheckStatusOk(SaveStatus.STABLE, Ballot.ZERO, Ballot.ZERO,
+                          None, Durability.NOT_DURABLE, None,
+                          stable_deps=Deps(KeyDeps.of({Key(505): {d2}})))
+        for m in (a.merge(b), b.merge(a)):
+            assert m.stable_deps.txn_id_set() == {d1, d2}
+
+    def test_merge_reunites_writes_slices(self):
+        """Per-store cmd.writes used to be range-sliced; replies carrying
+        different slices must merge to the union so a catching-up store is
+        never handed an empty writes slice for its own range."""
+        from accord_tpu.impl.list_store import ListWrite
+        tid = TxnId.create(1, 100, TxnKind.WRITE, Domain.KEY, 1)
+        from accord_tpu.primitives.writes import Writes
+        w = ListWrite({Key(5): 1, Key(505): 2})
+        wa = Writes(tid, tid, Keys.of(5), w)
+        wb = Writes(tid, tid, Keys.of(505), w)
+        a = CheckStatusOk(SaveStatus.PRE_APPLIED, Ballot.ZERO, Ballot.ZERO,
+                          tid, Durability.NOT_DURABLE, None, writes=wa)
+        b = CheckStatusOk(SaveStatus.PRE_APPLIED, Ballot.ZERO, Ballot.ZERO,
+                          tid, Durability.NOT_DURABLE, None, writes=wb)
+        for m in (a.merge(b), b.merge(a)):
+            assert {k.token for k in m.writes.keys} == {5, 505}
+
+    def test_truncated_known_deps_is_erased_not_stable(self):
+        """Truncation cleaned the deps up: Known.deps must sort below STABLE
+        (reference DepsErased < DepsKnown) so per-range reduces refuse to
+        treat a truncated source as holding decided deps."""
+        k = SaveStatus.TRUNCATED_APPLY.known()
+        assert k.deps == KnownDeps.ERASED
+        assert k.deps < KnownDeps.STABLE
+        mixed = SaveStatus.STABLE.known().reduce(k)
+        assert mixed.deps < KnownDeps.STABLE
+
+
+class TestKnownMap:
+    """Per-range knowledge provenance (CheckStatus.FoundKnownMap:298)."""
+
+    def test_known_for_gap_degrades_per_range_facts(self):
+        stable = SaveStatus.STABLE.known()
+        m = KnownMap.create(Ranges([Range(0, 10)]), stable)
+        got = m.known_for(Keys.of(5))
+        assert got.deps == KnownDeps.STABLE
+        assert got.definition == KnownDefinition.YES
+        # include an uncovered key: per-range facts degrade to the gap's
+        # NOTHING, global facts (executeAt) survive (Known.reduce)
+        got = m.known_for(Keys.of(5, 15))
+        assert got.deps == KnownDeps.UNKNOWN
+        assert got.definition == KnownDefinition.NO
+        assert got.execute_at == KnownExecuteAt.YES
+
+    def test_merge_is_rangewise_at_least(self):
+        a = KnownMap.create(Ranges([Range(0, 10)]),
+                            SaveStatus.PRE_ACCEPTED.known())
+        b = KnownMap.create(Ranges([Range(10, 20)]),
+                            SaveStatus.STABLE.known())
+        m = a.merge(b)
+        assert m.known_for(Keys.of(5)).deps == KnownDeps.UNKNOWN
+        assert m.known_for(Keys.of(15)).deps == KnownDeps.STABLE
+        both = m.known_for(Keys.of(5, 15))
+        assert both.deps == KnownDeps.UNKNOWN          # per-range: min
+        assert both.execute_at == KnownExecuteAt.YES   # global: max
+        assert m.known_for_any().deps == KnownDeps.STABLE
+
+    def test_reduce_route_rules(self):
+        full = Known(KnownRoute.FULL, KnownDefinition.NO,
+                     KnownExecuteAt.UNKNOWN, KnownDeps.UNKNOWN,
+                     SaveStatus.NOT_DEFINED.known().outcome)
+        covering = Known(KnownRoute.COVERING, KnownDefinition.NO,
+                         KnownExecuteAt.UNKNOWN, KnownDeps.UNKNOWN,
+                         SaveStatus.NOT_DEFINED.known().outcome)
+        assert full.reduce(covering).route == KnownRoute.FULL
+        assert covering.reduce(covering).route == KnownRoute.COVERING
+        assert covering.reduce(Known.NOTHING).route == KnownRoute.MAYBE
+
+    def test_wire_roundtrip(self):
+        from accord_tpu.host.wire import decode, encode
+        m = KnownMap.create(Ranges([Range(0, 10), Range(20, 30)]),
+                            SaveStatus.COMMITTED.known())
+        ok = CheckStatusOk(SaveStatus.COMMITTED, Ballot.ZERO, Ballot.ZERO,
+                           None, Durability.NOT_DURABLE, None, known_map=m)
+        back = decode(encode(ok))
+        assert back.known_map == m
+        assert back.known_for(Keys.of(25)).deps == KnownDeps.COMMITTED
+
+
+class TestPartialCoveragePropagate:
+    def test_partial_quorum_fetch_does_not_overclaim(self):
+        """A merged reply whose shard-B replicas never answered must not let
+        Propagate mark shard-B stores STABLE with under-covering deps (the
+        FoundKnownMap safety property): 5 nodes, rf 3, 2 topology shards —
+        shard A [0,500) on {1,2,3}, shard B [500,1000) on {2,3,4}. Node 2 is
+        partitioned during coordination, then fetches with CheckStatus
+        blocked to nodes 3 and 4: shard A reaches quorum (nodes 1+2), shard
+        B gets only node 2's own empty knowledge."""
+        cluster = SimCluster(n_nodes=5, seed=7, n_shards=2, rf=3,
+                             num_command_stores=2)
+
+        def drop_to_2(from_id, to_id, message):
+            return to_id == 2
+        cluster.network.add_filter(drop_to_2)
+        run(cluster, cluster.node(1).coordinate(write_txn({5: 1, 505: 2})))
+        cluster.process_all()
+        cluster.network.remove_filter(drop_to_2)
+
+        cmd1 = only_txn_cmd(cluster.node(1))[0]
+        assert cmd1.has_been(SaveStatus.PRE_APPLIED)
+
+        def drop_checkstatus(from_id, to_id, message):
+            return isinstance(message, CheckStatus) and to_id in (3, 4)
+        cluster.network.add_filter(drop_checkstatus)
+        merged = run(cluster, fetch_data(cluster.node(2), cmd1.txn_id,
+                                         cmd1.route))
+        cluster.process_all()
+        assert merged is not None
+        # node 1 applied, so the merged global status claims the outcome…
+        assert merged.save_status >= SaveStatus.PRE_APPLIED
+        # …but the provenance map must not claim deps for shard B
+        assert merged.known_for(Keys.of(505)).deps < KnownDeps.STABLE
+        assert merged.known_for(Keys.of(5)).deps == KnownDeps.STABLE
+
+        for store in cluster.node(2).command_stores.all():
+            c = store.commands.get(cmd1.txn_id)
+            if any(r.contains_token(505) for r in store.ranges):
+                # un-covered shard: must NOT have gone stable off the
+                # partial merge (pre-fix it committed empty-sliced deps)
+                assert c is None or not c.has_been(SaveStatus.STABLE)
+            elif any(r.contains_token(5) for r in store.ranges):
+                assert c is not None and c.has_been(SaveStatus.PRE_APPLIED)
+        # the data plane saw only shard A's write
+        assert cluster.node(2).data_store.get(Key(5)) == (1,)
+        assert cluster.node(2).data_store.get(Key(505)) in ((), None)
 
 
 class TestFetchData:
