@@ -13,10 +13,17 @@ publishes no numbers — BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Timing note: on the tunneled TPU platform, any device->host transfer flips
-the stream into synchronous dispatch (~8 ms RTT per call, measured), so the
-timed loop runs strictly BEFORE the first transfer and correctness checks
-happen after.
+Timing note (tunneled TPU platform): block_until_ready is NOT a reliable
+sync there (measured returning early), a device->host pull costs a full
+tunnel RTT (8-70 ms, variable), and after the first pull every dispatch
+degrades to synchronous. Honest timing therefore folds repetition counts
+INSIDE one jitted computation (iteration-skewed rolls deny loop-invariant
+hoisting; the Pallas kernel folds reps into its grid) and differences a
+small-rep call against a large-rep call, each made in the same post-pull
+dispatch regime — RTT and dispatch overheads cancel exactly. The scanned
+XLA timing bodies use the explicitly-XLA wavefront (pallas inside lax.scan
+fails to lower here); the TPC-C config times the fused Pallas window
+kernel via its reps-in-grid hook and labels the path in "kernel_path".
 
 Extra BASELINE configs (not part of the driver's one-line contract):
     python bench.py --config zipf1m      # 1M keys, 100k-txn batch, windowed
@@ -87,6 +94,49 @@ def scalar_edges_per_sec(cfks, batch):
     return edges / dt, edges
 
 
+def _xla_window_body(entry_rank, entry_eat_rank, entry_key, entry_status,
+                     entry_kind, txn_rank, txn_witness_mask, txn_kind,
+                     touches):
+    """resolve_step's pipeline with the explicitly-XLA wavefront, safe to
+    wrap in lax.scan (the platform's pallas lowering rejects pallas inside
+    scan). Returns the three summary scalars the bench aggregates."""
+    import jax.numpy as jnp
+
+    from accord_tpu.ops.deps_kernel import batched_active_deps, in_batch_graph
+    from accord_tpu.ops.wavefront import execution_waves
+
+    _, dep_count = batched_active_deps(
+        entry_rank, entry_eat_rank, entry_key, entry_status, entry_kind,
+        txn_rank, txn_witness_mask, touches)
+    dep_bb = in_batch_graph(txn_rank, txn_witness_mask, txn_kind, touches)
+    waves = execution_waves(dep_bb)
+    return (dep_count.sum(dtype=jnp.int32), dep_bb.sum(dtype=jnp.int32),
+            waves.max())
+
+
+def _default_reps_fn(reps: int):
+    """One jitted call = `reps` full resolve passes, iteration-skewed by
+    rolling the txn batch (results are permutation-invariant aggregates, so
+    every rep reproduces the same three scalars while denying the compiler
+    any loop-invariant hoisting)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(er, eer, ek, es, ekd, tr, twm, tkd, touches):
+        def body(carry, i):
+            ys = _xla_window_body(
+                er, eer, ek, es, ekd,
+                jnp.roll(tr, i), jnp.roll(twm, i), jnp.roll(tkd, i),
+                jnp.roll(touches, i, axis=0))
+            return carry, ys
+
+        _, ys = jax.lax.scan(body, 0, jnp.arange(reps))
+        return ys
+
+    return run
+
+
 def bench_default():
     import jax
 
@@ -101,20 +151,38 @@ def bench_default():
              s.entry_kind, b.txn_rank, b.txn_witness_mask, b.txn_kind,
              b.touches)]
 
-    # compile + warm up WITHOUT pulling results to the host (a transfer
-    # degrades all later dispatches to synchronous on the tunneled platform)
+    # correctness reference: one resolve_step call (the protocol-path
+    # pipeline, pallas wave on real TPU), pulled for the edge count
     out = resolve_step(*args)
-    jax.block_until_ready(out)
-
-    iters = 100
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = resolve_step(*args)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-
-    # correctness + edge count: transfers are safe now
     edges = int(np.asarray(out[1]).sum())
+
+    # HONEST timing: block_until_ready is not a reliable sync on the
+    # tunneled platform (measured returning early), a device->host pull
+    # costs a full tunnel RTT, and after the first pull every dispatch
+    # degrades to synchronous (each paying RTT). So fold the iterations
+    # INTO one jitted computation (lax.scan, iteration-skewed by rolling
+    # the batch so nothing is loop-invariant) and difference a 10-rep call
+    # against a 110-rep call — each is ONE dispatch + ONE pull, so RTT
+    # cancels exactly, leaving 100 reps of pure device time.
+    small_n, large_n = 10, 110
+    run_small = _default_reps_fn(small_n)
+    run_large = _default_reps_fn(large_n)
+    jax.block_until_ready(run_small(*args))
+    jax.block_until_ready(run_large(*args))
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        ys = fn(*args)
+        host = np.asarray(ys[0]), np.asarray(ys[1]), np.asarray(ys[2])
+        return time.perf_counter() - t0, host
+
+    t_small, h_small = timed(run_small)
+    t_large, h_large = timed(run_large)
+    for h in (h_small, h_large):
+        assert (h[0] == h[0][0]).all() and int(h[0][0]) == edges
+    dt = max(t_large - t_small, 1e-9)
+    iters = large_n - small_n
+
     device_eps = edges * iters / dt
 
     scalar_eps, scalar_edges = scalar_edges_per_sec(cfks, batch)
@@ -276,6 +344,38 @@ def _numpy_window_edges(wargs):
     return edges
 
 
+def _zipf_stack_fn(reps: int):
+    """One jitted call resolving a whole same-shape window stack `reps`
+    times (outer rep scan skewed by rolling both the window and txn-batch
+    axes; all aggregates are permutation-invariant). Returns the per-rep
+    total edge count [reps]."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(er, eer, ek, es, ekd, tr, twm, tkd, touches):
+        def rep(carry, i):
+            # entry arrays roll on the window axis; txn arrays additionally
+            # on the batch axis (denies loop-invariant hoisting even for
+            # single-window buckets)
+            ent = [jnp.roll(a, i, axis=0) for a in (er, eer, ek, es, ekd)]
+            txn = [jnp.roll(jnp.roll(a, i, axis=0), i, axis=1)
+                   for a in (tr, twm, tkd, touches)]
+
+            def body(c, xs):
+                return c, jnp.stack(_xla_window_body(*xs))     # [3] i32
+
+            _, per_win = jax.lax.scan(body, 0, tuple(ent + txn))
+            return carry, jnp.stack([per_win[:, 0].sum(),
+                                     per_win[:, 1].sum(),
+                                     per_win[:, 2].max()])
+
+        _, ys = jax.lax.scan(rep, 0, jnp.arange(reps))
+        return ys                                              # [reps, 3]
+
+    return run
+
+
 def bench_zipf1m(verify=False):
     """BASELINE row: Zipfian (α=0.99) 1M keys, 100k-txn batch, windowed at
     the protocol path's flush size. Reports total conflict edges resolved/s
@@ -287,30 +387,46 @@ def bench_zipf1m(verify=False):
     t_build = time.perf_counter()
     world = build_big_world()
     windows = encode_windows(world)
-    shapes = {}
-    for wargs in windows:
-        shapes[tuple(a.shape for a in wargs)] = wargs
     build_s = time.perf_counter() - t_build
 
-    # compile each shape bucket + warm up (no transfers!)
-    for wargs in shapes.values():
-        jax.block_until_ready(resolve_step(*[jax.device_put(a) for a in wargs]))
+    # group same-shape windows; each bucket becomes ONE stacked device
+    # dispatch (lax.scan over the stack) — see bench_tpcc's timing note
+    groups: dict = {}
+    for wargs in windows:
+        groups.setdefault(tuple(a.shape for a in wargs), []).append(wargs)
+    stacks = [tuple(jax.device_put(np.stack([w[i] for w in ws]))
+                    for i in range(9))
+              for ws in groups.values()]
 
-    dev_windows = [[jax.device_put(a) for a in wargs] for wargs in windows]
-    counts = []
-    t0 = time.perf_counter()
-    for wargs in dev_windows:
-        out = resolve_step(*wargs)
-        counts.append(out[1])
-        del out
-    jax.block_until_ready(counts)
-    dt = time.perf_counter() - t0
+    # warm-up ends with host pulls so both timed passes run in the same
+    # dispatch regime (see bench_tpcc's compile_fns note)
+    fn1, fn3 = _zipf_stack_fn(1), _zipf_stack_fn(3)
+    for fn in (fn1, fn3):
+        for st in stacks:
+            np.asarray(fn(*st))
 
-    edges = int(sum(int(np.asarray(c).sum()) for c in counts))
+    # HONEST timing: reps folded inside the jit (iteration-skewed rolls);
+    # difference one-rep and three-rep calls — tunnel RTT and dispatch
+    # overhead cancel, leaving device compute for one pass over every
+    # window (same methodology as bench_tpcc/bench_default).
+    def timed_pass(fn):
+        t0 = time.perf_counter()
+        outs = [fn(*st) for st in stacks]
+        host = [np.asarray(o) for o in outs]
+        return time.perf_counter() - t0, host
+
+    t1, h1 = timed_pass(fn1)
+    t3, h3 = timed_pass(fn3)
+    assert all((h == h[0]).all() for h in h3)          # reps agree
+    assert all((a[0] == b[0]).all() for a, b in zip(h1, h3))
+    dt = max((t3 - t1) / 2, 1e-9)
+
+    edges = sum(int(h[0][0]) for h in h1)
     if verify:
         for wi in (0, len(windows) // 2):
             want = _numpy_window_edges(windows[wi])
-            got = int(np.asarray(counts[wi]).sum())
+            dev = [jax.device_put(a) for a in windows[wi]]
+            got = int(np.asarray(resolve_step(*dev)[1]).sum())
             assert got == want, f"window {wi}: device {got} != host {want}"
     txns = world["n_batch"]
     print(json.dumps({
@@ -419,8 +535,7 @@ def bench_maelstrom(nodes=3, keys=100, n_ops=400, single_key=True,
 
 # ---------------------------------------------------------------- tpcc -----
 
-def _tpcc_resolve_fn():
-    import jax
+def _tpcc_resolve_core():
     import jax.numpy as jnp
 
     from accord_tpu.ops.deps_kernel import conflict_edges
@@ -428,7 +543,6 @@ def _tpcc_resolve_fn():
 
     P = 11
 
-    @jax.jit
     def resolve(prev_write_rank, txn_rank, txn_keys):
         """One window of the replay against watermark-pruned state.
 
@@ -454,6 +568,71 @@ def _tpcc_resolve_fn():
         return dep_count, dep_bb.sum(dtype=jnp.int32), waves.max()
 
     return resolve
+
+
+def _tpcc_stack_fn(use_pallas: bool, reps: int):
+    """Resolve a whole STACK of same-shape windows in ONE dispatch, `reps`
+    times (for the differencing timer — see bench_default's note): the
+    windows are independent given the host-precomputed prev-writer state.
+    On TPU the window body is the fused VMEM-resident Pallas kernel
+    (pallas_kernels.keyset_windows_pallas; reps folded into its grid, since
+    pallas inside lax.scan fails to lower here) — the XLA fallback
+    materialises all P*P [B,B] compare intermediates in HBM, which alone is
+    ~3.5 ms per 2048-txn window. Returns [reps, 3] i32 (cross edges,
+    in-window edges, max wave), identical rows."""
+    import jax
+    import jax.numpy as jnp
+
+    if use_pallas:
+        from accord_tpu.ops.pallas_kernels import keyset_windows_pallas
+
+        @jax.jit
+        def resolve_stack(prevs, ranks, keyss):
+            w = keyss.shape[0]
+
+            def rep(carry, i):
+                tk = jnp.roll(jnp.roll(keyss, i, axis=0), i, axis=1)
+                pv = jnp.roll(prevs, i, axis=0)
+                valid = tk >= 0
+                pw = jnp.where(
+                    valid,
+                    pv[jnp.arange(w)[:, None, None], jnp.clip(tk, 0, None)],
+                    -1)
+                # int32 is ample: <=22,528 cross edges/window, ~7.5M total
+                return carry, (pw >= 0).sum(dtype=jnp.int32)
+
+            _, cross_r = jax.lax.scan(rep, 0, jnp.arange(reps))
+            in_w, wave_w = keyset_windows_pallas(keyss, ranks, reps=reps)
+            in_tot = in_w.sum(dtype=jnp.int32)
+            wave_m = wave_w.max()
+            return jnp.stack(
+                [cross_r, jnp.full((reps,), in_tot), jnp.full((reps,), wave_m)],
+                axis=1)
+
+        return resolve_stack
+
+    @jax.jit
+    def resolve_stack(prevs, ranks, keyss):
+        def rep(carry, i):
+            pv = jnp.roll(prevs, i, axis=0)
+            tr = jnp.roll(jnp.roll(ranks, i, axis=0), i, axis=1)
+            tk = jnp.roll(jnp.roll(keyss, i, axis=0), i, axis=1)
+
+            def body(c, xs):
+                prev, trw, tkw = xs
+                dep_count, in_edges, max_wave = _tpcc_resolve_core()(
+                    prev, trw, tkw)
+                return c, (dep_count.sum(dtype=jnp.int32), in_edges, max_wave)
+
+            _, (cross_w, in_w, wave_w) = jax.lax.scan(body, 0, (pv, tr, tk))
+            return carry, jnp.stack([cross_w.sum(dtype=jnp.int32),
+                                     in_w.sum(dtype=jnp.int32),
+                                     wave_w.max()])
+
+        _, ys = jax.lax.scan(rep, 0, jnp.arange(reps))
+        return ys                                              # [reps, 3]
+
+    return resolve_stack
 
 
 def _witness_mask_for_write():
@@ -482,9 +661,8 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
     stock = 1000 + (s_w * 100_000 + items).astype(np.int64)
     keys = np.concatenate([district[:, None], stock], axis=1)   # [N, 11]
 
-    resolve = _tpcc_resolve_fn()
     last_writer: dict = {}
-    dev_windows = []
+    host_windows = []
     for w0 in range(0, n_txns, window):
         kwin = keys[w0:w0 + window]
         B = kwin.shape[0]
@@ -505,24 +683,63 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
         for b in range(B):                                      # state advance
             for k in kwin[b]:
                 last_writer[int(k)] = w0 + b
-        dev_windows.append(tuple(jax.device_put(a) for a in
-                                 (prev, txn_rank, txn_keys)))
+        host_windows.append((prev, txn_rank, txn_keys))
+
+    # stack same-K windows so each bucket is ONE device dispatch (a lax.scan
+    # over the stack) instead of one dispatch per window
+    buckets: dict = {}
+    for wargs in host_windows:
+        buckets.setdefault(wargs[0].shape[0], []).append(wargs)
+    want_pallas = PLATFORM not in ("cpu", "unprobed") \
+        and not PLATFORM.startswith("cpu-fallback")
+    dev_stacks = [tuple(jax.device_put(np.stack([w[i] for w in ws]))
+                        for i in range(3))
+                  for ws in buckets.values()]
     prep_s = time.perf_counter() - t_prep
 
-    # compile every K bucket (no transfers before the timed loop)
-    for args in {a[0].shape: a for a in dev_windows}.values():
-        jax.block_until_ready(resolve(*args))
+    # compile both rep counts for every K bucket; the warm-up ends with a
+    # host PULL so both timed passes below run in the same (post-transfer,
+    # synchronous-dispatch) regime — otherwise the one-rep pass would run
+    # async and the three-rep pass sync, and their difference would carry
+    # one uncancelled RTT per bucket. If the Pallas path fails to lower on
+    # this platform, fall back to pure XLA rather than crash.
+    def compile_fns(pallas: bool):
+        f1, f3 = _tpcc_stack_fn(pallas, 1), _tpcc_stack_fn(pallas, 3)
+        for args in dev_stacks:
+            np.asarray(f1(*args))
+            np.asarray(f3(*args))
+        return f1, f3
 
-    outs = []
-    t0 = time.perf_counter()
-    for args in dev_windows:
-        outs.append(resolve(*args))
-    jax.block_until_ready(outs)
-    dt = time.perf_counter() - t0
+    kernel_path = "pallas" if want_pallas else "xla"
+    try:
+        fn1, fn3 = compile_fns(want_pallas)
+    except Exception as exc:  # noqa: BLE001 — robustness for driver runs
+        if not want_pallas:
+            raise
+        import sys
+        print(f"tpcc: pallas path failed ({type(exc).__name__}: {exc}); "
+              f"falling back to XLA", file=sys.stderr)
+        kernel_path = "xla-fallback"
+        fn1, fn3 = compile_fns(False)
 
-    cross = sum(int(np.asarray(o[0]).sum()) for o in outs)
-    inwin = sum(int(np.asarray(o[1])) for o in outs)
-    max_wave = max(int(np.asarray(o[2])) for o in outs)
+    # HONEST timing (see bench_default's note): one-rep and three-rep calls
+    # are each ONE dispatch + ONE pull per bucket; their difference / 2 is
+    # pure device compute for one pass over all windows.
+    def timed_pass(fn):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for args in dev_stacks]
+        host = [np.asarray(o) for o in outs]
+        return time.perf_counter() - t0, host
+
+    t1, h1 = timed_pass(fn1)
+    t3, h3 = timed_pass(fn3)
+    assert all((h == h[0]).all() for h in h3)          # reps agree
+    assert all((a[0] == b[0]).all() for a, b in zip(h1, h3))
+    dt = max((t3 - t1) / 2, 1e-9)
+
+    cross = sum(int(h[0][0]) for h in h1)
+    inwin = sum(int(h[0][1]) for h in h1)
+    max_wave = max(int(h[0][2]) for h in h1)
     print(json.dumps({
         "metric": "tpcc_neworder_resolve_ms",
         "value": round(dt * 1e3, 2),
@@ -535,8 +752,10 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
         "edges_cross_window": cross,
         "edges_in_window": inwin,
         "max_wave_depth": max_wave,
-        "windows": len(dev_windows),
+        "windows": len(host_windows),
+        "kernel_path": kernel_path,
         "txns_per_sec": round(n_txns / dt, 1),
+        "wall_ms_with_tunnel_rtt": round(t1 * 1e3, 2),
         "host_prep_seconds": round(prep_s, 2),
     }))
 
