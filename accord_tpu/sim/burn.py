@@ -298,6 +298,11 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     store_factory = None
     if args.device_store:
+        # the device store initialises jax: probe the (possibly
+        # dead-tunneled) TPU backend with a timeout first, falling back to
+        # CPU, or the CLI blocks forever on backend resolution
+        from accord_tpu.utils.backend import resolve_platform
+        resolve_platform()
         from accord_tpu.impl.device_store import DeviceCommandStore
         store_factory = DeviceCommandStore.factory(
             flush_window_us=args.flush_window_us, verify=args.device_verify)
