@@ -18,7 +18,8 @@ from typing import Dict, Optional
 
 from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Timeout
 from accord_tpu.coordinate.execute import ExecutePath, Propose
-from accord_tpu.coordinate.tracking import FastPathTracker, RequestStatus
+from accord_tpu.coordinate.tracking import (FastPathTracker, QuorumTracker,
+                                            RequestStatus)
 from accord_tpu.messages.apply_msg import ApplyKind
 from accord_tpu.messages.base import Callback, TxnRequest
 from accord_tpu.messages.commit import CommitKind
@@ -45,6 +46,12 @@ class CoordinateTransaction(Callback):
         self.topologies = None
         self.tracker: Optional[FastPathTracker] = None
         self.oks: Dict[int, PreAcceptOk] = {}
+        # replies from epoch-extension rounds, a LIST not a node-keyed dict:
+        # a node owning shards in both the original and extended epochs
+        # replies in both rounds (the second via preaccept's REDUNDANT arm —
+        # same stored executeAt, but deps freshly calculated over its
+        # newly-owned ranges), and both replies' deps must survive the merge
+        self.extra_oks: list = []
         self.done = False
 
     # ------------------------------------------------------------ preaccept --
@@ -97,24 +104,57 @@ class CoordinateTransaction(Callback):
     def _on_preaccepted(self) -> None:
         """Quorum of PreAcceptOks (CoordinateTransaction.onPreAccepted)."""
         self.done = True
-        oks = list(self.oks.values())
-        merged_deps = Deps.merge([ok.deps for ok in oks])
+        self._decide(list(self.oks.values()))
+
+    def _decide(self, oks) -> None:
         if self.permit_fast_path and self.tracker.has_fast_path_accepted:
-            # fast path: execute at the original timestamp
+            # fast path: execute at the original timestamp (fast-path votes
+            # are witnessed_at == txnId, so no epoch extension can apply)
             self.node.events.on_fast_path_taken(self.txn_id)
             self._execute(CommitKind.STABLE_FAST_PATH,
-                          self.txn_id.as_timestamp(), merged_deps)
+                          self.txn_id.as_timestamp(),
+                          Deps.merge([ok.deps for ok in oks]))
         else:
             max_witnessed = max(ok.witnessed_at for ok in oks)
             if max_witnessed.is_rejected:
                 self._fail(Invalidated("preaccept rejected"))
                 return
+            if max_witnessed.epoch > self.topologies.current_epoch:
+                # the epoch we will accept in is LATER than the epochs that
+                # informed this proposal: it may have moved ahead — a new
+                # owner can hold committed conflicts above our timestamp, so
+                # deciding now could order us beneath writes it already
+                # applied. PreAccept at the later epochs first (non-voting
+                # for the fast path; they witness us and inform the
+                # timestamp) — AbstractCoordinatePreAccept.onNewEpoch
+                # :200-236.
+                self._extend_epochs(max_witnessed.epoch)
+                return
             self.node.events.on_slow_path_taken(self.txn_id)
+            merged_deps = Deps.merge([ok.deps for ok in oks])
             Propose(self.node, self.txn_id, self.txn, self.route, Ballot.ZERO,
                     max_witnessed, merged_deps,
                     lambda stable_deps: self._stabilise_then_execute(
                         max_witnessed, stable_deps),
                     self._fail).start()
+
+    def _extend_epochs(self, latest: int) -> None:
+        prev = self.topologies
+
+        def ready():
+            new_tops = self.node.topology.with_unsynced_epochs(
+                self.route.participants(), self.txn_id.epoch, latest)
+            extra = new_tops.for_epochs(prev.current_epoch + 1, latest)
+            self.topologies = new_tops
+            # equivalent-shards shortcut (reference :224-230): if ownership
+            # did not move, the original quorum already covers every future
+            # owner — no extra round needed
+            if all(t.shards == prev.current().shards for t in extra):
+                self._decide(list(self.oks.values()) + self.extra_oks)
+                return
+            _ExtraEpochRound(self, extra).start()
+
+        self.node.with_epoch(latest, ready)
 
     def _stabilise_then_execute(self, execute_at: Timestamp, deps: Deps
                                 ) -> None:
@@ -138,3 +178,60 @@ class CoordinateTransaction(Callback):
         if isinstance(failure, Timeout):
             self.node.events.on_timeout(self.txn_id)
         self.result.try_failure(failure)
+
+
+class _ExtraEpochRound(Callback):
+    """Non-voting PreAccept round against the epochs between the original
+    coordination topologies and the proposed executeAt's epoch (reference
+    AbstractCoordinatePreAccept.ExtraEpochs): the later epochs' owners
+    witness the txn and their proposals inform the final timestamp, so a
+    moved-ahead epoch cannot leave the decision beneath conflicts its new
+    owners already committed. Votes here never count toward the fast path
+    (the replicas' epoch exceeds txnId's, so they propose fresh HLC
+    stamps)."""
+
+    def __init__(self, parent: CoordinateTransaction, topologies):
+        self.parent = parent
+        self.topologies = topologies
+        self.tracker = QuorumTracker(topologies)
+        self.done = False
+
+    def start(self) -> None:
+        p = self.parent
+        for to in self.topologies.nodes():
+            scope = TxnRequest.compute_scope(to, self.topologies, p.route)
+            if scope is None:
+                continue
+            partial = p.txn.slice(scope.covering(), include_query=False)
+            p.node.send(
+                to, PreAccept(p.txn_id, partial, scope,
+                              self.topologies.current_epoch,
+                              full_route=p.route),
+                callback=self,
+                timeout_s=p.node.agent.pre_accept_timeout())
+
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        if isinstance(reply, PreAcceptNack):
+            self.done = True
+            self.parent._fail(
+                Preempted(f"extension PreAccept nacked by {from_id}"))
+            return
+        invariants.check_state(isinstance(reply, PreAcceptOk),
+                               "unexpected reply %s", reply)
+        self.parent.extra_oks.append(reply)
+        if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
+            self.done = True
+            # recurses through _decide if the extended proposal crosses yet
+            # another epoch
+            self.parent._decide(list(self.parent.oks.values())
+                                + self.parent.extra_oks)
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        if self.tracker.record_failure(from_id) == RequestStatus.FAILED:
+            self.done = True
+            self.parent._fail(failure if isinstance(failure, Timeout)
+                              else Exhausted(repr(failure)))

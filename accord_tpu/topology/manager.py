@@ -137,8 +137,11 @@ class TopologyManager:
         pending = self._pending.get(epoch)
         if pending is None:
             pending = self._pending[epoch] = AsyncResult()
-            if self._fetch_hook is not None:
-                self._fetch_hook(epoch)
+        if self._fetch_hook is not None:
+            # re-trigger on every await: the hook dedupes in-flight fetches
+            # itself, and a fetch that failed (source unreachable) must be
+            # retriable by the next waiter rather than wedging every one
+            self._fetch_hook(epoch)
         return pending
 
     # -- coordination epoch-window selection --

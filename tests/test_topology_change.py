@@ -157,3 +157,86 @@ class TestBurnWithTopologyChanges:
                       drop_prob=0.05, topology_period_s=2.0)
         stats = run.run()
         assert stats.acks > 0
+
+
+class TestEpochExtensionRound:
+    def test_slow_path_extends_into_execution_epoch(self):
+        """A slow-path executeAt landing in a later epoch must be informed by
+        that epoch's owners BEFORE it is decided (reference
+        AbstractCoordinatePreAccept.onNewEpoch:200-236): epoch 2 moves the
+        shard to {3,4,5}, where node 4's clock runs 1h ahead and has
+        committed+applied a conflicting write B. A coordinator still at
+        epoch 1 deciding from the old {1,2,3} quorum alone would pick an
+        executeAt BENEATH B — logically reordering a write B's replicas
+        already applied (and any read in between non-prefix). The extension
+        round PreAccepts at the new owners, whose proposals lift the
+        decision above every conflict they hold."""
+        from accord_tpu.primitives.timestamp import Timestamp
+
+        cluster = SimCluster(n_nodes=5, seed=97, n_shards=1, rf=3)
+        assert cluster.topology.shard_for_token(5).nodes == (1, 2, 3)
+
+        # keep node 1 epoch-blind until A is in flight: drop epoch gossip to
+        # it AND gate its ledger lookups (its lazy fetch is a local read)
+        def drop_epoch_to_1(from_id, to_id, message):
+            return to_id == 1 and \
+                type(message).__module__ == "accord_tpu.messages.epoch"
+        cluster.network.add_filter(drop_epoch_to_1)
+        gate = {"open": False}
+        real_lookup = cluster.config_services[1]._lookup
+        cluster.config_services[1]._lookup = \
+            lambda epoch: real_lookup(epoch) if gate["open"] else None
+
+        top2 = Topology(2, [Shard(Range(0, 1000), (3, 4, 5))])
+        cluster.topology = top2
+        cluster.topology_ledger[2] = top2
+        for nid in (2, 3, 4, 5):
+            cluster.config_services[nid].report_topology(top2)
+        cluster.process_all()
+        assert cluster.node(1).epoch == 1    # still blind
+        assert cluster.node(4).epoch == 2
+
+        # node 4's clock runs far ahead; commit B at key 5 through {4,5}
+        # while node 3 is unreachable, so node 3 never witnesses B
+        n4 = cluster.node(4)
+        n4.on_remote_timestamp(Timestamp(2, n4.now_us() + 3_600_000_000, 0, 4))
+
+        def drop_to_3(from_id, to_id, message):
+            return to_id == 3
+        cluster.network.add_filter(drop_to_3)
+        run_txn(cluster, 4, rw_txn([], {5: 7}))
+        cluster.process_all()
+        cluster.network.remove_filter(drop_to_3)
+
+        b_cmds = [c for s in n4.command_stores.all()
+                  for c in s.commands.values()
+                  if c.txn_id.kind == TxnKind.WRITE]
+        assert len(b_cmds) == 1
+        b_at = b_cmds[0].execute_at
+
+        # A from the epoch-blind coordinator: nodes 2,3 answer with epoch-2
+        # stamps (their epoch advanced), forcing the slow path AND an
+        # executeAt epoch beyond the coordination topologies. The ledger
+        # gate opens only after the txn id is minted at epoch 1, so the
+        # extension round's own fetch can then succeed.
+        result = cluster.node(1).coordinate(rw_txn([], {5: 9}))
+        gate["open"] = True
+        ok = cluster.process_until(lambda: result.is_done,
+                                   max_items=2_000_000)
+        assert ok, "A did not complete"
+        if result.failure() is not None:
+            raise result.failure()
+        cluster.process_all()
+
+        a_cmds = [c for s in cluster.node(1).command_stores.all()
+                  for c in s.commands.values()
+                  if c.txn_id.kind == TxnKind.WRITE
+                  and c.txn_id.node == 1]          # A's coordinator; B's
+        assert len(a_cmds) == 1                    # record is a dep stub
+        a_cmd = a_cmds[0]
+        assert a_cmd.execute_at.epoch == 2
+        # THE safety property: the decision cleared the moved-ahead owner's
+        # applied conflict instead of sliding beneath it
+        assert a_cmd.execute_at > b_at, (a_cmd.execute_at, b_at)
+        # and the data plane agrees on the order at the new owners
+        assert cluster.node(5).data_store.get(Key(5)) == (7, 9)
