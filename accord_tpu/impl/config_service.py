@@ -89,14 +89,16 @@ class AbstractConfigurationService(ConfigurationService):
 
     def register_listener(self, listener) -> None:
         self.listeners.append(listener)
-        # wire the node's lazy epoch acquisition: Node.with_epoch on an
-        # epoch nobody has gossiped yet must actively fetch it (reference
-        # Node.withEpoch -> ConfigurationService.fetchTopologyForEpoch) —
-        # without this, an epoch-extension round or a message gated on a
-        # future epoch waits forever on gossip that may be lost
-        manager = getattr(listener, "topology", None)
-        if manager is not None and hasattr(manager, "set_fetch_hook"):
-            manager.set_fetch_hook(self.fetch_topology_for_epoch)
+
+    def attach_node(self, node) -> None:
+        """Register a Node as listener AND wire its lazy epoch acquisition:
+        Node.with_epoch on an epoch nobody has gossiped yet must actively
+        fetch it (reference Node.withEpoch ->
+        ConfigurationService.fetchTopologyForEpoch) — without the hook, an
+        epoch-extension round or a message gated on a future epoch waits
+        forever on gossip that may be lost."""
+        self.register_listener(node)
+        node.topology.set_fetch_hook(self.fetch_topology_for_epoch)
 
     # ----------------------------------------------------------------- feed --
     def report_topology(self, topology, start_sync: bool = True) -> None:

@@ -299,10 +299,21 @@ class Node:
         """Run fn once `epoch` is locally known (Node.withEpoch)."""
         if self.topology.has_epoch(epoch):
             fn()
-        else:
-            self.topology.await_epoch(epoch).add_callback(
-                lambda v, f: fn() if f is None else self.agent
-                .on_uncaught_exception(f))
+            return
+        pending = self.topology.await_epoch(epoch)
+        pending.add_callback(
+            lambda v, f: fn() if f is None else self.agent
+            .on_uncaught_exception(f))
+
+        # a transient fetch failure must not wedge the waiter forever:
+        # re-arm the (deduplicated) fetch until the epoch lands — gossip
+        # resolving the pending future first makes the timer a no-op
+        def refetch():
+            if not pending.is_done:
+                self.topology.await_epoch(epoch)   # re-triggers the hook
+                self.scheduler.once(1.0, refetch)
+
+        self.scheduler.once(1.0, refetch)
 
     # ------------------------------------------------------------ messaging --
     def send(self, to_nodes, request: Request,

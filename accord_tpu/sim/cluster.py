@@ -120,7 +120,7 @@ class SimCluster:
             # (reference AbstractConfigurationService): the node is a
             # listener, the cluster ledger serves gap fetches
             service = DirectConfigService(nid, self.topology_ledger.get)
-            service.register_listener(node)
+            service.attach_node(node)
             self.config_services[nid] = service
             service.report_topology(self.topology)
 

@@ -1,0 +1,114 @@
+"""Dedicated unit tier for the async-chain and bitset foundations.
+
+Reference model: accord/utils/async/AsyncChainsTest.java (map/flatMap/
+callback ordering, failure propagation, reduce/all combinators) and
+accord/utils/SimpleBitSetTest.java (set/unset/navigation laws, randomized
+against a model set).
+"""
+
+import random
+
+import pytest
+
+from accord_tpu.utils.async_chains import (AsyncResult, all_of, failure,
+                                           reduce, success)
+from accord_tpu.utils.bitset import SimpleBitSet
+
+
+class TestAsyncResult:
+    def test_callbacks_fire_once_whenever_registered(self):
+        r = AsyncResult()
+        seen = []
+        r.add_callback(lambda v, f: seen.append(("early", v, f)))
+        assert r.try_success(7)
+        assert not r.try_success(8)          # settle exactly once
+        assert not r.try_failure(RuntimeError("late"))
+        r.add_callback(lambda v, f: seen.append(("late", v, f)))
+        assert seen == [("early", 7, None), ("late", 7, None)]
+        assert r.is_done and r.is_success and r.value() == 7
+
+    def test_failure_propagates_through_map_chain(self):
+        boom = RuntimeError("boom")
+        out = failure(boom).map(lambda v: v + 1).flat_map(
+            lambda v: success(v)).map(lambda v: v * 2)
+        assert out.is_done and not out.is_success
+        assert out.failure() is boom
+
+    def test_map_and_flat_map_compose(self):
+        base = AsyncResult()
+        out = base.map(lambda v: v + 1).flat_map(lambda v: success(v * 10))
+        assert not out.is_done               # laziness until the source
+        base.set_success(4)
+        assert out.value() == 50
+
+    def test_map_fn_raising_becomes_failure(self):
+        out = success(1).map(lambda v: 1 // 0)
+        assert out.is_done and not out.is_success
+        assert isinstance(out.failure(), ZeroDivisionError)
+
+    def test_recover_swallows_failure_only(self):
+        assert failure(RuntimeError("x")).recover(lambda f: 42).value() == 42
+        assert success(5).recover(lambda f: 42).value() == 5
+
+    def test_all_of_collects_in_order_and_fails_fast(self):
+        a, b, c = AsyncResult(), AsyncResult(), AsyncResult()
+        out = all_of([a, b, c])
+        c.set_success(3)
+        a.set_success(1)
+        assert not out.is_done
+        b.set_success(2)
+        assert out.value() == [1, 2, 3]       # source order, not settle order
+
+        x, y = AsyncResult(), AsyncResult()
+        bad = all_of([x, y])
+        boom = RuntimeError("first failure wins")
+        y.set_failure(boom)
+        assert bad.is_done and bad.failure() is boom
+        x.set_success(0)                      # straggler ignored
+        assert bad.failure() is boom
+
+    def test_all_of_empty_and_reduce(self):
+        assert all_of([]).value() == []
+        out = reduce([success(1), success(2), success(4)], lambda a, b: a | b)
+        assert out.value() == 7
+
+
+class TestSimpleBitSet:
+    def test_set_unset_report_change(self):
+        bs = SimpleBitSet(8)
+        assert bs.set(3) and not bs.set(3)
+        assert bs.get(3) and bs.count() == 1
+        assert bs.unset(3) and not bs.unset(3)
+        assert bs.is_empty
+
+    def test_full_and_iteration(self):
+        bs = SimpleBitSet.full(5)
+        assert bs.count() == 5 and list(bs) == [0, 1, 2, 3, 4]
+        assert len(bs) == 5
+
+    def test_navigation_laws_randomized(self):
+        rng = random.Random(7)
+        for _ in range(50):
+            size = rng.randrange(1, 70)
+            members = sorted(rng.sample(range(size),
+                                        rng.randrange(0, size + 1)))
+            bs = SimpleBitSet(size)
+            for m in members:
+                bs.set(m)
+            assert sorted(bs) == members
+            assert bs.count() == len(members)
+            assert bs.first_set() == (members[0] if members else -1)
+            assert bs.last_set() == (members[-1] if members else -1)
+            for probe in range(size):
+                ge = [m for m in members if m >= probe]
+                le = [m for m in members if m <= probe]
+                assert bs.next_set(probe) == (ge[0] if ge else -1)
+                assert bs.prev_set(probe) == (le[-1] if le else -1)
+
+    def test_equality_is_content_based(self):
+        a, b = SimpleBitSet(10), SimpleBitSet(10)
+        a.set(4)
+        b.set(4)
+        assert a == b and hash(a) == hash(b)
+        b.set(5)
+        assert a != b
