@@ -100,6 +100,8 @@ class Node:
         self.journal = None
         self.coordinating: Dict[TxnId, AsyncResult] = {}
         self._reply_seq = 0
+        # epochs with a live shared refetch timer chain (_ensure_epoch_fetch)
+        self._epoch_refetch: set = set()
         # spans with a staleness-escalation bootstrap in flight (dedup), and
         # spans that re-escalated while covered by an in-flight attempt
         # (needing a fresh fence once it completes)
@@ -300,20 +302,30 @@ class Node:
         if self.topology.has_epoch(epoch):
             fn()
             return
-        pending = self.topology.await_epoch(epoch)
-        pending.add_callback(
+        self.topology.await_epoch(epoch).add_callback(
             lambda v, f: fn() if f is None else self.agent
             .on_uncaught_exception(f))
+        self._ensure_epoch_fetch(epoch)
 
-        # a transient fetch failure must not wedge the waiter forever:
-        # re-arm the (deduplicated) fetch until the epoch lands — gossip
-        # resolving the pending future first makes the timer a no-op
-        def refetch():
-            if not pending.is_done:
-                self.topology.await_epoch(epoch)   # re-triggers the hook
-                self.scheduler.once(1.0, refetch)
+    def _ensure_epoch_fetch(self, epoch: int) -> None:
+        """ONE 1 Hz refetch chain per pending epoch, shared by every waiter
+        (with_epoch and receive()'s gate alike): a transient topology-fetch
+        failure must not wedge waiters, so the (deduplicated) fetch re-arms
+        until the epoch lands; gossip resolving the pending future first
+        stops the chain."""
+        if epoch in self._epoch_refetch or self.topology.has_epoch(epoch):
+            return
+        self._epoch_refetch.add(epoch)
+        pending = self.topology.await_epoch(epoch)
 
-        self.scheduler.once(1.0, refetch)
+        def tick():
+            if pending.is_done:
+                self._epoch_refetch.discard(epoch)
+                return
+            self.topology.await_epoch(epoch)       # re-triggers the hook
+            self.scheduler.once(1.0, tick)
+
+        self.scheduler.once(1.0, tick)
 
     # ------------------------------------------------------------ messaging --
     def send(self, to_nodes, request: Request,
@@ -360,6 +372,7 @@ class Node:
         if wait_for and not self.topology.has_epoch(wait_for):
             self.topology.await_epoch(wait_for).add_callback(
                 lambda v, f: self._process(request, from_id, reply_context))
+            self._ensure_epoch_fetch(wait_for)
             return
         self._process(request, from_id, reply_context)
 

@@ -54,13 +54,27 @@ class RecoverOk(Reply):
         return self.execute_at is not None \
             and self.execute_at == self.txn_id.as_timestamp()
 
+    def _rank(self):
+        """Cross-reply ranking key (reference Status.max over phase +
+        acceptedOrCommitted ballot): ACCEPTED and ACCEPTED_INVALIDATE are
+        the SAME phase and compete by BALLOT — a higher-ballot promise to
+        invalidate supersedes a lower-ballot accepted proposal and vice
+        versa. Ranking them by status first let a recovery re-propose a
+        stale ballot-zero Accept over a decided higher-ballot invalidation,
+        splitting replicas between STABLE and INVALIDATED (burn seed 6000).
+        Decided statuses (PreCommitted+) still dominate every accept."""
+        phase = (SaveStatus.ACCEPTED
+                 if self.status in (SaveStatus.ACCEPTED,
+                                    SaveStatus.ACCEPTED_INVALIDATE)
+                 else self.status)
+        return (phase, self.accepted_ballot, self.status)
+
     def merge(self, other: "RecoverOk") -> "RecoverOk":
         """Cross-shard / cross-node knowledge union (BeginRecovery.reduce;
-        `hi` is Status.max by (status, accepted ballot) — for ACCEPTED the
-        highest-ballot proposal's executeAt is the one recovery must adopt)."""
-        hi, lo = ((self, other)
-                  if (self.status, self.accepted_ballot)
-                  >= (other.status, other.accepted_ballot) else (other, self))
+        `hi` per _rank — for the accept phase the highest-ballot proposal
+        is the one recovery must adopt)."""
+        hi, lo = ((self, other) if self._rank() >= other._rank()
+                  else (other, self))
         accepted_ballot = max(self.accepted_ballot, other.accepted_ballot)
         partial_txn = (self.partial_txn.with_(other.partial_txn)
                        if self.partial_txn is not None

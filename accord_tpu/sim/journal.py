@@ -72,6 +72,30 @@ def _keys_of(keys_or_ranges) -> Set:
         return set()
 
 
+def _uncovered(needed: Set, have: Set) -> Set:
+    """Elements of `needed` not COVERED by `have`. Exact membership is not
+    enough for the range domain: a command's stored body is its message
+    body sliced to the store's ranges (e.g. Propagate slices before
+    installing), so under topology splits the live body can hold a FRAGMENT
+    [0,250) of a journaled definition [0,500) — covered, not missing."""
+    from accord_tpu.primitives.keys import Range, Ranges
+
+    missing = needed - have
+    if not missing:
+        return missing
+    have_ranges = Ranges([h for h in have if isinstance(h, Range)])
+    if have_ranges.is_empty:
+        return missing
+    out = set()
+    for n in missing:
+        if isinstance(n, Range):
+            if not Ranges([n]).subtract(have_ranges).is_empty:
+                out.add(n)
+        elif not have_ranges.contains(n):
+            out.add(n)
+    return out
+
+
 def reconstruct(records: List[object]) -> Dict[TxnId, Reconstruction]:
     """Fold a node's journal into per-txn reconstructed knowledge
     (SerializerSupport.reconstruct's message-picking, as one pass)."""
@@ -164,7 +188,8 @@ def validate_node(node) -> Tuple[int, int]:
                 continue
             assert r is not None and r.witnessed, f"{ctx}: never journaled"
             if cmd.partial_txn is not None:
-                missing = _keys_of(cmd.partial_txn.keys) - r.definition_keys
+                missing = _uncovered(_keys_of(cmd.partial_txn.keys),
+                                     r.definition_keys)
                 assert not missing, \
                     f"{ctx}: definition keys {missing} not journaled"
             if st >= SaveStatus.PRE_COMMITTED and cmd.execute_at is not None:

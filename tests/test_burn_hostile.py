@@ -90,3 +90,22 @@ def test_burn_hostile_device_store():
     hits = sum(s.device_hits for node in run.cluster.nodes.values()
                for s in node.command_stores.all())
     assert hits > 0
+
+
+def test_burn_regression_recovery_ballot_ranking():
+    """Seed 6000 under heavy loss + partitions + drift + delayed multi-store:
+    a recovery once re-proposed a stale ballot-zero Accept over a decided
+    higher-ballot invalidation (RecoverOk.merge ranked by status before
+    ballot), splitting replicas between STABLE and INVALIDATED; a Propagate
+    of the invalidation then crashed against the stable fast-path commit.
+    The divergence fired at virtual ~198s of this exact 400-op trajectory —
+    shorter prefixes change the client schedule and miss it (~170s wall,
+    the heaviest test in the suite; it guards a safety property)."""
+    from accord_tpu.sim.delayed_store import DelayedCommandStore
+    from accord_tpu.utils.random_source import RandomSource
+    run = BurnRun(6000, 400, nodes=3, keys=12, n_shards=2, drop_prob=0.2,
+                  partitions=True, clock_drift=True, num_command_stores=4,
+                  store_factory=DelayedCommandStore.factory(
+                      RandomSource(6000 ^ 0x5D5D)))
+    stats = run.run()
+    assert stats.lost == 0 and stats.pending == 0

@@ -106,3 +106,28 @@ def test_crash_rebuild_by_journal_replay():
                 assert other.execute_at == cmd.execute_at, txn_id
                 checked += 1
     assert checked > 0
+
+
+class TestDefinitionCoverage:
+    def test_range_fragments_count_as_covered(self):
+        """A command's stored body is its message body sliced to the store's
+        ranges, so under topology splits the live body can hold a FRAGMENT
+        of a journaled definition range — coverage, not exact membership,
+        is the reconstruction contract (burn seed 6000 surfaced this for an
+        exclusive sync point after a shard split)."""
+        from accord_tpu.primitives.keys import Key, Range
+        from accord_tpu.sim.journal import _uncovered
+
+        # fragment [0,250) of a journaled [0,500): covered
+        assert _uncovered({Range(0, 250)}, {Range(0, 500)}) == set()
+        # spanning two journaled pieces: covered
+        assert _uncovered({Range(100, 400)},
+                          {Range(0, 250), Range(250, 500)}) == set()
+        # genuinely missing tail survives
+        assert _uncovered({Range(400, 600)}, {Range(0, 500)}) \
+            == {Range(400, 600)}
+        # keys: exact membership or range coverage both count
+        k = Key(7)
+        assert _uncovered({k}, {k}) == set()
+        assert _uncovered({k}, {Range(0, 10)}) == set()
+        assert _uncovered({Key(11)}, {Range(0, 10)}) == {Key(11)}

@@ -341,3 +341,48 @@ class TestBurnWithRecovery:
             stats = run.run()
             assert stats.pending == 0
             assert stats.acks > 0
+
+
+class TestRecoverOkBallotRanking:
+    def test_higher_ballot_accept_invalidate_supersedes_stale_accept(self):
+        """ACCEPTED and ACCEPTED_INVALIDATE are the same Paxos phase and
+        must compete by BALLOT (reference Status.max over phase +
+        acceptedOrCommitted): recovery re-proposing a stale ballot-zero
+        Accept over a decided higher-ballot invalidation split replicas
+        between STABLE and INVALIDATED (burn seed 6000)."""
+        from accord_tpu.messages.recover import RecoverOk
+        from accord_tpu.primitives.latest_deps import LatestDeps
+        from accord_tpu.primitives.deps import Deps
+        from accord_tpu.primitives.timestamp import (Ballot, Domain, TxnId,
+                                                     TxnKind)
+
+        tid = TxnId.create(22, 100, TxnKind.WRITE, Domain.KEY, 3)
+        b1 = Ballot(23, 200, 0, 1)
+
+        def ok(status, ballot, at):
+            return RecoverOk(tid, status, ballot, at, LatestDeps.EMPTY,
+                             None, None, None, False, Deps.NONE, Deps.NONE)
+
+        stale_accept = ok(SaveStatus.ACCEPTED, Ballot.ZERO,
+                          tid.as_timestamp())
+        invalidating = ok(SaveStatus.ACCEPTED_INVALIDATE, b1, None)
+        for m in (stale_accept.merge(invalidating),
+                  invalidating.merge(stale_accept)):
+            assert m.status == SaveStatus.ACCEPTED_INVALIDATE
+            assert m.accepted_ballot == b1
+
+        # and the converse: an Accept at a HIGHER ballot than the
+        # invalidation promise is the live proposal
+        high_accept = ok(SaveStatus.ACCEPTED, Ballot(23, 300, 0, 2),
+                         tid.as_timestamp())
+        low_invalidate = ok(SaveStatus.ACCEPTED_INVALIDATE, b1, None)
+        for m in (high_accept.merge(low_invalidate),
+                  low_invalidate.merge(high_accept)):
+            assert m.status == SaveStatus.ACCEPTED
+            assert m.execute_at == tid.as_timestamp()
+
+        # decided statuses still dominate any accept-phase ballot
+        committed = ok(SaveStatus.COMMITTED, Ballot.ZERO, tid.as_timestamp())
+        for m in (committed.merge(invalidating),
+                  invalidating.merge(committed)):
+            assert m.status == SaveStatus.COMMITTED
