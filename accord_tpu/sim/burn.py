@@ -32,6 +32,17 @@ class BurnStats:
         self.nacks = 0
         self.lost = 0
         self.pending = 0
+        # submit->ack VIRTUAL latency per acked op (us): the measurement for
+        # SURVEY §7's flush-window-latency hard part — the batched device
+        # store must not inflate the fast path's single-round-trip advantage
+        self.ack_latencies_us: list = []
+
+    def latency_us(self, pct: float) -> int:
+        """Percentile (0..100) of acked-op latency; -1 with no acks."""
+        if not self.ack_latencies_us:
+            return -1
+        s = sorted(self.ack_latencies_us)
+        return s[min(len(s) - 1, int(len(s) * pct / 100.0))]
 
     def __repr__(self):
         return (f"acks={self.acks} nacks={self.nacks} lost={self.lost} "
@@ -163,6 +174,7 @@ class BurnRun:
                     self.stats.nacks += 1
                 elif isinstance(value, ListResult):
                     self.stats.acks += 1
+                    self.stats.ack_latencies_us.append(end_us - start_us)
                     reads = {k.token: v for k, v in value.read_values.items()}
                     if isinstance(txn.keys, Ranges):
                         # a range read asserts the FULL content of the window:
@@ -341,7 +353,12 @@ def main(argv=None) -> int:
             extra = (f" device[hits={h} misses={m} batches={b} "
                      f"probes={p} max_batch={mx} "
                      f"recovery_hits={rh} recovery_misses={rm}]")
+        def lat(pct):
+            us = stats.latency_us(pct)
+            return f"{us / 1e3:.1f}ms" if us >= 0 else "n/a"
+
         print(f"seed={seed} ops={args.ops} {stats} "
+              f"lat_p50={lat(50)} lat_p95={lat(95)} "
               f"virtual_time={run.cluster.now_s:.1f}s "
               f"events={run.cluster.queue.processed} OK{extra}")
         if args.message_stats:

@@ -103,3 +103,21 @@ def test_device_store_serves_recovery_scans():
             misses += s.device_recovery_misses
     assert hits + misses > 0, "recovery probes never reached the device path"
     assert hits > 0, f"no recovery scan was device-served (misses={misses})"
+
+
+def test_flush_window_latency_bounded():
+    """SURVEY §7's flagged hard part: the batched device path accumulates
+    scans into flush windows, which must NOT inflate the fast path's
+    single-round-trip advantage. Same seed, clean network: the device
+    store's ack-latency percentiles stay within a few milliseconds of the
+    scalar store's (measured +2.9ms p50 / +6.8ms p95 against WAN-scale
+    ~77ms baselines; the bound leaves headroom without letting a
+    pathological batching delay merge green)."""
+    scalar = BurnRun(510, 60).run()
+    device = BurnRun(510, 60, store_factory=DeviceCommandStore.factory(
+        flush_window_us=200, verify=False)).run()
+    assert scalar.acks == device.acks == 60
+    assert device.latency_us(50) <= scalar.latency_us(50) + 10_000, \
+        (device.latency_us(50), scalar.latency_us(50))
+    assert device.latency_us(95) <= scalar.latency_us(95) + 15_000, \
+        (device.latency_us(95), scalar.latency_us(95))
