@@ -277,6 +277,10 @@ class DeviceCommandStore(CommandStore):
         self.device_max_batch = 0
         self.device_recovery_hits = 0
         self.device_recovery_misses = 0
+        # set when the device backend dies mid-run (e.g. the TPU tunnel
+        # drops): the store keeps serving every scan through the scalar
+        # path instead of crashing the node
+        self.device_disabled = False
 
     @classmethod
     def factory(cls, flush_window_us: int = 0, verify: bool = False):
@@ -288,6 +292,11 @@ class DeviceCommandStore(CommandStore):
         return DeviceSafeCommandStore(self, context)
 
     def _submit(self, context: PreLoadContext, fn, result) -> None:
+        if self.device_disabled:
+            # degraded store: no batched precompute will ever run, so skip
+            # the dead flush-window deferral entirely
+            super()._submit(context, fn, result)
+            return
         self._window.append((context, fn, result))
         if not self._flush_scheduled:
             self._flush_scheduled = True
@@ -302,8 +311,24 @@ class DeviceCommandStore(CommandStore):
         window, self._window = self._window, []
         if not window:
             return
-        self._precompute(window)
-        self._precompute_recovery(window)
+        if not self.device_disabled:
+            try:
+                self._precompute(window)
+                self._precompute_recovery(window)
+            except Exception as exc:  # noqa: BLE001 — mid-run backend death
+                if self.verify:
+                    # equivalence-certification mode must not silently
+                    # degrade to a scalar-only run that still reports OK:
+                    # a kernel/encoder regression surfaces here
+                    raise
+                # a dying tunneled backend must not take the replica down:
+                # disable the device tier for this store and serve every
+                # scan through the scalar path from here on (recorded via
+                # the agent so harnesses can assert on backend incidents)
+                self.device_disabled = True
+                self._precomputed = {}
+                self._precomputed_recovery = {}
+                self.agent.on_handled_exception(exc)
         try:
             for context, fn, result in window:
                 super()._submit(context, fn, result)

@@ -12,6 +12,7 @@ Usage:  python -m accord_tpu.sim.burn -s SEED -o OPS [--nodes N] [--drop P]
 from __future__ import annotations
 
 import argparse
+import math
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -38,11 +39,13 @@ class BurnStats:
         self.ack_latencies_us: list = []
 
     def latency_us(self, pct: float) -> int:
-        """Percentile (0..100) of acked-op latency; -1 with no acks."""
+        """Nearest-rank percentile (0..100] of acked-op latency; -1 with no
+        acks."""
         if not self.ack_latencies_us:
             return -1
         s = sorted(self.ack_latencies_us)
-        return s[min(len(s) - 1, int(len(s) * pct / 100.0))]
+        rank = math.ceil(len(s) * pct / 100.0)
+        return s[min(len(s) - 1, max(0, rank - 1))]
 
     def __repr__(self):
         return (f"acks={self.acks} nacks={self.nacks} lost={self.lost} "
@@ -339,7 +342,7 @@ def main(argv=None) -> int:
                     print(dump)
         extra = ""
         if args.device_store:
-            h = m = b = p = rh = rm = 0
+            h = m = b = p = rh = rm = dis = 0
             mx = 0
             for node in run.cluster.nodes.values():
                 for s in node.command_stores.all():
@@ -350,9 +353,11 @@ def main(argv=None) -> int:
                     mx = max(mx, s.device_max_batch)
                     rh += s.device_recovery_hits
                     rm += s.device_recovery_misses
+                    dis += s.device_disabled
             extra = (f" device[hits={h} misses={m} batches={b} "
                      f"probes={p} max_batch={mx} "
-                     f"recovery_hits={rh} recovery_misses={rm}]")
+                     f"recovery_hits={rh} recovery_misses={rm}"
+                     + (f" DISABLED={dis}" if dis else "") + "]")
         def lat(pct):
             us = stats.latency_us(pct)
             return f"{us / 1e3:.1f}ms" if us >= 0 else "n/a"

@@ -35,7 +35,9 @@ class SimAgent(Agent):
         self.cluster.queue.fail(failure)
 
     def on_handled_exception(self, failure: BaseException) -> None:
-        pass
+        # recorded (so harnesses can assert on incidents like a mid-run
+        # device-backend death) but NOT fatal to the simulation
+        self.failures.append(failure)
 
     def pre_accept_timeout(self) -> float:
         return 1.0  # virtual second

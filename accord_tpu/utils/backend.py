@@ -34,9 +34,16 @@ def resolve_platform(probe_timeout_s: float = 90.0) -> str:
     platform = os.environ.get("JAX_PLATFORMS", "")
     if platform == "cpu":
         return "cpu"
+    # the probe exercises the REAL wedge path — device compile + execute +
+    # device->host pull — not just backend discovery: a flaky tunnel can
+    # enumerate devices and still hang on first use
+    probe_src = (
+        "import jax, numpy as np\n"
+        "x = jax.jit(lambda a: (a @ a).sum())(jax.numpy.ones((256, 256)))\n"
+        "print('ok' if float(np.asarray(x)) > 0 else 'bad')\n")
     try:
         out = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            [sys.executable, "-c", probe_src],
             capture_output=True, timeout=probe_timeout_s, text=True)
         if out.returncode == 0 and "ok" in out.stdout:
             return platform or "default"

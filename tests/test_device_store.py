@@ -121,3 +121,38 @@ def test_flush_window_latency_bounded():
         (device.latency_us(50), scalar.latency_us(50))
     assert device.latency_us(95) <= scalar.latency_us(95) + 15_000, \
         (device.latency_us(95), scalar.latency_us(95))
+
+
+def test_backend_death_falls_back_to_scalar(monkeypatch):
+    """A TPU backend dying MID-RUN (e.g. the tunnel drops) must not take the
+    replica down: in production mode (verify off) the store disables its
+    device tier on the first failed flush and serves every scan through the
+    scalar path; the burn completes and its strict-serializability verifier
+    runs clean. In verify (equivalence-certification) mode the failure
+    re-raises instead — a kernel regression must not silently degrade an
+    OK-reporting run to scalar-only."""
+    calls = {"n": 0}
+    orig = DeviceCommandStore._precompute
+
+    def dying(self, window):
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise RuntimeError("Unable to initialize backend 'axon'")
+        return orig(self, window)
+
+    monkeypatch.setattr(DeviceCommandStore, "_precompute", dying)
+    run = BurnRun(612, 40, store_factory=DeviceCommandStore.factory(
+        flush_window_us=200, verify=False))
+    stats = run.run()
+    assert stats.lost == 0 and stats.pending == 0
+    stores = [s for node in run.cluster.nodes.values()
+              for s in node.command_stores.all()]
+    assert any(s.device_disabled for s in stores)
+    assert any(a.failures for a in run.cluster.agents.values())
+    assert calls["n"] >= 4
+
+    # verify mode: the same failure is fatal, not maskable
+    calls["n"] = 0
+    with pytest.raises(RuntimeError, match="axon"):
+        BurnRun(612, 40, store_factory=DeviceCommandStore.factory(
+            flush_window_us=200, verify=True)).run()
