@@ -71,7 +71,9 @@ class HostAgent(Agent):
         print(f"uncaught: {failure!r}", file=sys.stderr, flush=True)
 
     def on_handled_exception(self, failure: BaseException) -> None:
-        pass
+        # recovered-from incidents (e.g. the device tier degrading to
+        # scalar on a mid-run backend death) must still be operator-visible
+        print(f"handled: {failure!r}", file=sys.stderr, flush=True)
 
     def pre_accept_timeout(self) -> float:
         return 1.0

@@ -166,3 +166,95 @@ class TestDepsPair:
         for_all(Gens.lists(key_deps_model(), max_size=3),
                 Gens.lists(range_deps_model(), max_size=3),
                 examples=80)(prop)
+
+
+class TestKeyDepsAlgebraMore:
+    def test_with_matches_model_union_and_associativity(self):
+        """Pairwise linear CSR union (with_) agrees with the dict model and
+        associates (KeyDepsTest's union laws)."""
+        def prop(ma, mb, mc):
+            a, b, c = KeyDeps.of(ma), KeyDeps.of(mb), KeyDeps.of(mc)
+            assert as_model(a.with_(b)) == model_union(ma, mb)
+            assert (a.with_(b)).with_(c) == a.with_(b.with_(c))
+            assert a.with_(KeyDeps.NONE) == a
+        for_all(key_deps_model(), key_deps_model(), key_deps_model(),
+                examples=60)(prop)
+
+    def test_canonical_equality_across_construction_orders(self):
+        """Equal models build EQUAL CSR structures regardless of insertion
+        order (RelationMultiMap.testEquality's canonical-form contract)."""
+        def prop(m, seed):
+            import random as _r
+            rng = _r.Random(seed)
+            b1, b2 = KeyDeps.builder(), KeyDeps.builder()
+            pairs = [(k, t) for k, ts in m.items() for t in ts]
+            for k, t in pairs:
+                b1.add(k, t)
+            rng.shuffle(pairs)
+            for k, t in pairs:
+                b2.add(k, t)
+                if rng.random() < 0.2:
+                    b2.add(k, t)            # duplicates collapse
+            d1, d2 = b1.build(), b2.build()
+            assert d1 == d2 and hash(d1) == hash(d2)
+        for_all(key_deps_model(), Gens.ints(0, 2**31), examples=60)(prop)
+
+    def test_unique_txn_id_enumeration(self):
+        def prop(m):
+            d = KeyDeps.of(m)
+            seen = []
+            d.for_each_unique_txn_id(seen.append)
+            want = set().union(*m.values()) if m else set()
+            assert set(seen) == want
+            assert len(seen) == len(want), "duplicate enumeration"
+            assert seen == sorted(seen), "not in TxnId order"
+            for t in want:
+                assert d.contains(t)
+        for_all(key_deps_model(), examples=60)(prop)
+
+    def test_slice_boundaries(self):
+        def prop(m):
+            d = KeyDeps.of(m)
+            assert d.slice(Ranges(())).is_empty
+            assert as_model(d.slice(Ranges([Range(0, 1 << 40)]))) == \
+                {k: v for k, v in m.items() if v}
+            # exclusive upper bound: a range ending AT a key excludes it
+            for k in list(m)[:2]:
+                if m[k]:
+                    sliced = d.slice(Ranges([Range(k.token, k.token + 1)]))
+                    assert as_model(sliced) == ({k: m[k]} if m[k] else {})
+                    if k.token > 0:
+                        below = d.slice(Ranges([Range(0, k.token)]))
+                        assert below.txn_ids_for_key(k) == []
+        for_all(key_deps_model(), examples=60)(prop)
+
+
+def range_pair_model():
+    trip = Gens.tuples(Gens.ints(0, 40), Gens.ints(1, 30), Gens.ints(1, 60))
+    return Gens.lists(trip, max_size=25).map(
+        lambda ts: {(s, s + w): {tid(h, node=1 + h % 3,
+                                     domain=Domain.RANGE)}
+                    for s, w, h in ts})
+
+
+class TestRangeDepsAlgebraMore:
+    def test_with_matches_model(self):
+        def prop(ma, mb):
+            def build(m):
+                b = RangeDeps.builder()
+                for (s, e), ts in m.items():
+                    for t in ts:
+                        b.add(Range(s, e), t)
+                return b.build()
+            a, b = build(ma), build(mb)
+            u = a.with_(b)
+            want = {}
+            for m in (ma, mb):
+                for r, ts in m.items():
+                    want.setdefault(r, set()).update(ts)
+            got = {}
+            for i, r in enumerate(u.ranges):
+                got.setdefault((r.start, r.end), set()).update(
+                    u.txn_ids_for_range_idx(i))
+            assert got == {r: ts for r, ts in want.items() if ts}
+        for_all(range_pair_model(), range_pair_model(), examples=40)(prop)
