@@ -311,23 +311,32 @@ def main(argv=None) -> int:
                         help="record structured protocol events per node and "
                              "print the tail after the run")
     args = parser.parse_args(argv)
-    store_factory = None
     if args.device_store:
         # the device store initialises jax: probe the (possibly
         # dead-tunneled) TPU backend with a timeout first, falling back to
         # CPU, or the CLI blocks forever on backend resolution
         from accord_tpu.utils.backend import resolve_platform
         resolve_platform()
-        from accord_tpu.impl.device_store import DeviceCommandStore
-        store_factory = DeviceCommandStore.factory(
-            flush_window_us=args.flush_window_us, verify=args.device_verify)
-    elif args.delayed_stores:
-        from accord_tpu.sim.delayed_store import DelayedCommandStore
-        from accord_tpu.utils.random_source import RandomSource
-        store_factory = DelayedCommandStore.factory(
-            RandomSource(args.seed ^ 0x5D5D))
+
+    def make_store_factory(seed: int):
+        # built PER SEED: a shared delayed-store RandomSource would carry
+        # its state across --loops iterations, making a failure at loop
+        # seed N irreproducible by `-s N` alone (burn soaks found exactly
+        # that: a seed-15003 violation that vanished standalone)
+        if args.device_store:
+            from accord_tpu.impl.device_store import DeviceCommandStore
+            return DeviceCommandStore.factory(
+                flush_window_us=args.flush_window_us,
+                verify=args.device_verify)
+        if args.delayed_stores:
+            from accord_tpu.sim.delayed_store import DelayedCommandStore
+            from accord_tpu.utils.random_source import RandomSource
+            return DelayedCommandStore.factory(RandomSource(seed ^ 0x5D5D))
+        return None
+
     for i in range(args.loops):
         seed = args.seed + i
+        store_factory = make_store_factory(seed)
         run = BurnRun(seed, args.ops, nodes=args.nodes, keys=args.keys,
                       n_shards=args.shards, drop_prob=args.drop,
                       store_factory=store_factory,
