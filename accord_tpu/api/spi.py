@@ -278,6 +278,9 @@ class LocalConfig:
     command_store_shard_count: int = 8
     # RPC reply timeout = agent.pre_accept_timeout() * this
     rpc_timeout_multiplier: float = 10.0
+    # recovery/invalidation futures are force-failed after
+    # rpc_timeout * this (see Node._arm_coordination_watchdog)
+    coordination_watchdog_multiplier: float = 6.0
     bootstrap_retry_delay_s: float = 1.0
     durability_shard_cycle_s: float = 30.0
     durability_global_cycle_every: int = 4

@@ -61,6 +61,7 @@ class Recover(Callback):
         topologies = self.node.topology.precise_epochs(
             self.route.participants(), self.txn_id.epoch, self.txn_id.epoch)
         self.tracker = RecoveryTracker(topologies)
+        sent = 0
         for to in topologies.nodes():
             scope = TxnRequest.compute_scope(to, topologies, self.route)
             if scope is None:
@@ -68,6 +69,13 @@ class Recover(Callback):
             self.node.send(to, BeginRecovery(self.txn_id, scope, self.ballot,
                                              full_route=self.route),
                            callback=self)
+            sent += 1
+        if sent == 0:
+            # never leave the round incomplete: the result is deduplicated
+            # through Node.coordinating, so a silent no-op wedges all future
+            # recovery of this txn
+            self._fail(Exhausted(
+                f"recovery of {self.txn_id} found no reachable participants"))
 
     def on_success(self, from_id: int, reply) -> None:
         if self.done or self.ballot_promised:
@@ -311,7 +319,17 @@ class Recover(Callback):
     def _await_commits(self, waiting_on: Deps) -> None:
         """WaitOnCommit each blocking dep at a quorum of the shards it
         participates in at THIS key range (its own route may be wider, but
-        only the intersection with ours gates our decision)."""
+        only the intersection with ours gates our decision).
+
+        Deps here span BOTH domains: a key-domain recovery can be gated on an
+        earlier accepted RANGE transaction (earlier_no_witness range arm,
+        store._earlier_accepted_no_witness_ranges) — route each dep through
+        the participants of its own domain.  A dep that yields no reachable
+        destinations must fail the round rather than leave it forever
+        incomplete: recovery futures are deduplicated through
+        Node.coordinating, so a never-settling round permanently wedges ALL
+        future recovery of this txn (seed-15003 soak: an acked write was
+        lost exactly this way)."""
         dep_ids = waiting_on.sorted_txn_ids()
         remaining = [len(dep_ids)]
 
@@ -326,19 +344,33 @@ class Recover(Callback):
                 self._retry()
 
         for dep_id in dep_ids:
-            participants = waiting_on.key_deps.participants(dep_id)
-            topologies = self.node.topology.with_unsynced_epochs(
-                participants, self.txn_id.epoch, self.txn_id.epoch)
-            dep_route = Route(self.route.home_key,
-                              keys=participants.as_routing(), is_full=False)
-            tracker = QuorumTracker(topologies)
-            waiter = _AwaitCommit(tracker, one_done)
-            for to in topologies.nodes():
-                scope = TxnRequest.compute_scope(to, topologies, dep_route)
-                if scope is None:
-                    continue
-                self.node.send(to, WaitOnCommit(dep_id, scope),
-                               callback=waiter)
+            key_parts, range_parts = waiting_on.participants(dep_id)
+            if len(key_parts) > 0:
+                participants = key_parts
+                dep_route = Route(self.route.home_key,
+                                  keys=key_parts.as_routing(), is_full=False)
+            else:
+                participants = range_parts
+                dep_route = Route(self.route.home_key, ranges=range_parts,
+                                  is_full=False)
+            sent = 0
+            if len(participants) > 0:
+                topologies = self.node.topology.with_unsynced_epochs(
+                    participants, self.txn_id.epoch, self.txn_id.epoch)
+                tracker = QuorumTracker(topologies)
+                waiter = _AwaitCommit(tracker, one_done)
+                for to in topologies.nodes():
+                    scope = TxnRequest.compute_scope(to, topologies, dep_route)
+                    if scope is None:
+                        continue
+                    self.node.send(to, WaitOnCommit(dep_id, scope),
+                                   callback=waiter)
+                    sent += 1
+            if sent == 0:
+                one_done(failure=Exhausted(
+                    f"await-commits of {dep_id} for recovery of "
+                    f"{self.txn_id} found no reachable participants"))
+                return
 
     def _retry(self) -> None:
         """Re-run the recovery round at the same ballot with a FRESH instance
@@ -413,6 +445,7 @@ class CollectDeps(Callback):
         topologies = self.node.topology.with_unsynced_epochs(
             self.route.participants(), self.txn_id.epoch, self.before.epoch)
         self.tracker = QuorumTracker(topologies)
+        sent = 0
         for to in topologies.nodes():
             scope = TxnRequest.compute_scope(to, topologies, self.route)
             if scope is None:
@@ -422,6 +455,12 @@ class CollectDeps(Callback):
             self.node.send(
                 to, GetDeps(self.txn_id, scope, participants, self.before),
                 callback=self)
+            sent += 1
+        if sent == 0:
+            self.fired = True
+            self.on_done(None, failure=Exhausted(
+                f"collect-deps for {self.txn_id} found no reachable "
+                f"participants"))
 
     def on_success(self, from_id: int, reply) -> None:
         if self.fired:

@@ -259,6 +259,7 @@ class Node:
             return result
         self.coordinating[txn_id] = result
         result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
+        self._arm_coordination_watchdog(txn_id, result, "coordination")
         self.with_epoch(txn_id.epoch,
                         lambda: CoordinateTransaction(self, txn_id, txn,
                                                       result).start())
@@ -273,6 +274,7 @@ class Node:
         result = AsyncResult()
         self.coordinating[txn_id] = result
         result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
+        self._arm_coordination_watchdog(txn_id, result, "recovery")
         if self.trace.enabled:
             self.trace.event("recover", txn_id=txn_id)
         self.with_epoch(txn_id.epoch,
@@ -290,12 +292,35 @@ class Node:
         result = AsyncResult()
         self.coordinating[txn_id] = result
         result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
+        self._arm_coordination_watchdog(txn_id, result, "invalidation")
         if self.trace.enabled:
             self.trace.event("invalidate", txn_id=txn_id)
         self.with_epoch(txn_id.epoch,
                         lambda: Invalidate(self, txn_id, some_route,
                                            result).start())
         return result
+
+    def _arm_coordination_watchdog(self, txn_id: TxnId, result: AsyncResult,
+                                   what: str) -> None:
+        """Force-fail a coordination/recovery/invalidation future that
+        outlives every plausible sequence of its RPC rounds.  These futures are
+        deduplicated through `coordinating`, so ANY code path that fails to
+        settle (a round that sent zero messages, a reply handler that
+        returns without continuing) otherwise pins a dead future there
+        forever — after which the progress log's escalations all no-op and
+        a wedged txn is never repaired (seed-15003 soak: an acked write
+        was lost to exactly that).  The watchdog converts such a bug into a
+        bounded stall: the failure pops the dedup entry and the next
+        escalation starts a fresh coordinator."""
+        timeout_s = (self.agent.pre_accept_timeout()
+                     * self.config.rpc_timeout_multiplier
+                     * self.config.coordination_watchdog_multiplier)
+        timer = self.scheduler.once(
+            timeout_s,
+            lambda: result.try_failure(Timeout(
+                f"{what} of {txn_id} did not settle within {timeout_s:.1f}s "
+                f"(non-settling coordination path)")))
+        result.add_callback(lambda v, f: timer.cancel())
 
     def with_epoch(self, epoch: int, fn: Callable[[], None]) -> None:
         """Run fn once `epoch` is locally known (Node.withEpoch)."""

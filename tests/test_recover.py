@@ -323,6 +323,95 @@ class TestProgressLog:
         assert done
 
 
+class TestAwaitCommitsRangeDeps:
+    def test_recovery_gated_on_accepted_range_txn_settles(self):
+        """A key-write recovery whose fast-path decision is gated on an
+        earlier ACCEPTED range txn that never witnessed it must route
+        WaitOnCommit through the dep's RANGE participants and, whatever
+        happens, SETTLE its result.  Regression: the await round consulted
+        key-deps participants only — empty for a range dep — so it sent
+        nothing and never completed; recovery futures are deduplicated
+        through Node.coordinating, so the dead future pinned there forever
+        and the txn (plus everything execution-ordered behind it) was never
+        repaired.  Found by the seed-15000→15003 chained soak, which lost
+        an ACKED append this way (SOAK_NOTES.md round 3)."""
+        from accord_tpu.messages.accept import Accept
+        from accord_tpu.messages.base import TxnRequest
+        from accord_tpu.messages.commit import CommitKind
+        from accord_tpu.primitives.deps import Deps
+        from accord_tpu.primitives.keys import Ranges
+        from accord_tpu.primitives.timestamp import Ballot
+
+        cluster = SimCluster(n_nodes=3, seed=77)  # no progress log: the
+        n1 = cluster.node(1)                      # only recovery is ours
+
+        from accord_tpu.impl.list_store import ListQuery, ListRangeRead
+        ranges = Ranges.of((0, 100))
+        rr = Txn(TxnKind.READ, ranges, read=ListRangeRead(ranges),
+                 query=ListQuery())
+        rr_id = n1.next_txn_id(TxnKind.READ, Domain.RANGE)
+        rr_route = n1.compute_route(rr)
+
+        # the later key write (key 10 lies inside the range), abandoned
+        # once PreAccept reached every replica
+        w_id, w_route, client = abandoned_txn(
+            cluster, 1, rw_txn([], {10: 7}),
+            drop=lambda f, t, m: isinstance(m, (Commit, Apply)))
+        assert client.failure() is not None
+        assert rr_id < w_id
+
+        # the range read reaches ACCEPTED everywhere at an executeAt AFTER
+        # the write's id, with proposed deps that do NOT witness the write
+        rr_at = n1.unique_now()
+        assert rr_at > w_id.as_timestamp()
+        topos = n1.topology.with_unsynced_epochs(
+            rr_route.participants(), rr_id.epoch, rr_id.epoch)
+        for to in topos.nodes():
+            scope = TxnRequest.compute_scope(to, topos, rr_route)
+            partial = rr.slice(scope.covering(), include_query=False)
+            cluster.node(to).receive(
+                PreAccept(rr_id, partial, scope, rr_id.epoch,
+                          full_route=rr_route), 1, None)
+            cluster.node(to).receive(
+                Accept(rr_id, Ballot.ZERO, scope, ranges, rr_at, Deps.NONE,
+                       full_route=rr_route), 1, None)
+        cluster.process_until(lambda: all(
+            n.command_stores.stores[0].commands[rr_id].save_status
+            == SaveStatus.ACCEPTED for n in cluster.nodes.values()))
+        for n in cluster.nodes.values():
+            st = n.command_stores.stores[0]
+            assert st.commands[rr_id].save_status == SaveStatus.ACCEPTED
+            assert st.commands[w_id].save_status == SaveStatus.PRE_ACCEPTED
+
+        # recovery must settle (pre-fix: the await-commits round hung and
+        # process_until drained the queue with the future still pending)
+        res = cluster.node(3).recover(w_id, w_route)
+        settled = cluster.process_until(lambda: res.is_done,
+                                        max_items=500_000)
+        assert settled, "recovery future never settled (await-commits wedge)"
+
+        # once the range txn commits, a fresh recovery decides the write;
+        # every replica converges and nothing is left un-settleable
+        for to in topos.nodes():
+            scope = TxnRequest.compute_scope(to, topos, rr_route)
+            partial = rr.slice(scope.covering(), include_query=False)
+            cluster.node(to).receive(
+                Commit(CommitKind.STABLE_MAXIMAL, rr_id, scope, partial,
+                       rr_at, Deps.NONE, full_route=rr_route), 1, None)
+        for attempt in range(8):
+            res2 = cluster.node(3).recover(w_id, w_route)
+            assert cluster.process_until(lambda: res2.is_done,
+                                         max_items=500_000)
+            statuses = {n.command_stores.stores[0].commands[w_id].save_status
+                        for n in cluster.nodes.values()}
+            if all(s >= SaveStatus.PRE_COMMITTED or s.is_truncated
+                   or s == SaveStatus.INVALIDATED for s in statuses):
+                break
+        else:
+            raise AssertionError(
+                f"write never decided after range dep committed: {statuses}")
+
+
 class TestBurnWithRecovery:
     def test_burn_with_drops_and_progress_log(self):
         """Lossy network + progress log: every submitted op settles, strict
