@@ -147,9 +147,13 @@ class DeviceSafeCommandStore(SafeCommandStore):
         return {k: keyed[k] for k in keys if keyed.get(k)}
 
     def _rejects_fast_path_keys(self, txn_id: TxnId, participants) -> bool:
+        # the batched masks enumerate RAW candidates; elision suppression
+        # (CommandsForKey._missing_explicable_by_elision) is a host-side
+        # post-filter shared with the scalar path
         def scalar_collect(out):
             for cfk in self._participant_cfks(participants):
-                found = cfk.started_after_without_witnessing_ids(txn_id)
+                found = cfk.started_after_without_witnessing_ids(txn_id,
+                                                                 raw=True)
                 if found:
                     out.setdefault(cfk.key, []).extend(found)
 
@@ -160,7 +164,8 @@ class DeviceSafeCommandStore(SafeCommandStore):
 
         def scalar_collect_b(out):
             for cfk in self._participant_cfks(participants):
-                found = cfk.executes_after_without_witnessing_ids(txn_id)
+                found = cfk.executes_after_without_witnessing_ids(txn_id,
+                                                                 raw=True)
                 if found:
                     out.setdefault(cfk.key, []).extend(found)
 
@@ -168,7 +173,17 @@ class DeviceSafeCommandStore(SafeCommandStore):
                                         scalar_collect_b)
         if served_b is None:
             return super()._rejects_fast_path_keys(txn_id, participants)
-        return bool(served_a) or bool(served_b)
+        return self._any_unsuppressed(served_a, txn_id) \
+            or self._any_unsuppressed(served_b, txn_id)
+
+    def _any_unsuppressed(self, served: Dict, txn_id: TxnId) -> bool:
+        for key, ids in served.items():
+            cfk = self.cfk(key)
+            for t in ids:
+                if not cfk._missing_explicable_by_elision(cfk._pos(t),
+                                                          txn_id):
+                    return True
+        return False
 
     def _earlier_committed_witness_keys(self, txn_id, participants,
                                         builder) -> None:

@@ -152,7 +152,8 @@ class CommandsForKey:
     """All transactions witnessed at one key, ordered by TxnId, with the
     missing[] divergence encoding and a committed-by-executeAt view."""
 
-    __slots__ = ("key", "_ids", "_status", "_eat", "_missing", "_committed",
+    __slots__ = ("key", "_ids", "_status", "_eat", "_missing", "_wdeps",
+                 "_committed",
                  "redundant_before", "version", "last_mutator",
                  "committed_version", "_block_heap", "_wait_heap", "_wait_seq")
 
@@ -162,6 +163,13 @@ class CommandsForKey:
         self._status: List[InternalStatus] = []
         self._eat: List[Optional[Timestamp]] = []
         self._missing: List[Tuple[TxnId, ...]] = []
+        # the WRITE ids among each entry's registered deps at this key —
+        # the entry's potential elision covers.  Resolved to timestamps at
+        # QUERY time (locally-known executeAt when committed, id as a
+        # lower bound otherwise) so a dep that commits after registration
+        # contributes its real executeAt (see
+        # _missing_explicable_by_elision)
+        self._wdeps: List[Tuple[TxnId, ...]] = []
         # (executeAt, txn_id) sorted, for entries COMMITTED..APPLIED
         self._committed: List[Tuple[Timestamp, TxnId]] = []
         # lazy min-heap of (block_point, txn_id) over non-terminal entries —
@@ -272,6 +280,7 @@ class CommandsForKey:
         self._eat.insert(i, None if execute_at is None or execute_at == txn_id
                          else execute_at)
         self._missing.insert(i, ())
+        self._wdeps.insert(i, ())
         self._push_block_point(i)
         if not status.is_decided:
             # every existing entry with known deps whose bound should have
@@ -326,6 +335,8 @@ class CommandsForKey:
             if txn_id.witnesses(t):
                 missing.append(t)
         self._missing[pos] = tuple(missing)
+        self._wdeps[pos] = tuple(sorted(
+            t for t in dep_set if t.is_key_domain and t.kind.is_write))
 
     def register_historical(self, txn_id: TxnId) -> None:
         """Witness a txn known only through another replica's deps
@@ -349,7 +360,7 @@ class CommandsForKey:
                 if self._status[i].is_committed:
                     self._committed_remove(self._ids[i], self._eat_of(i))
                 del self._ids[i], self._status[i], self._eat[i], \
-                    self._missing[i]
+                    self._missing[i], self._wdeps[i]
             for j in range(len(self._missing)):
                 m = self._missing[j]
                 if m and any(t in dropped for t in m):
@@ -493,29 +504,78 @@ class CommandsForKey:
     # the four BeginRecovery predicates (BeginRecovery.java:329-380).
     # The *_ids variants return the matching ids (the batched device store
     # verifies its precomputed masks against them); the bool forms delegate.
-    def started_after_without_witnessing_ids(self, txn_id: TxnId
+    def _missing_explicable_by_elision(self, i: int, txn_id: TxnId) -> bool:
+        """Entry i carries deps that omit `txn_id` — is that omission
+        explicable by TRANSITIVE ELISION rather than evidence that txn_id
+        was never witnessed?
+
+        The deps calc (map_reduce_active) elides any committed entry whose
+        executeAt lies below the last-executing committed write, so a
+        fast-path-committed txn_id (executeAt == txn_id) is legally ABSENT
+        from a later entry's deps wherever a committed write bound covered
+        it.  The recovery reject predicates consult the missing[] encoding
+        under exactly the fast-path hypothesis; reading an elision-shaped
+        omission as a fast-path refutation invalidated a COMMITTED txn in
+        a soak burn (seed 16005: fast commit on a reduced electorate, a
+        later committed write as the elision bound, and a recovery quorum
+        that avoided every committed copy).  The reference ships the same
+        elision with an unproven-correctness TODO
+        (CommandsForKey.java:640 PRUNE_TRANSITIVE_DEPENDENCIES); this
+        predicate-side guard is our correction: the omission is
+        inconclusive iff entry i's REGISTERED deps witness some write
+        executing after txn_id — under the hypothesis that write must
+        itself order after txn_id, so depending on it transitively covers
+        it.  The write-dep ids were recorded from the true dep list at
+        registration (the missing[] encoding can't answer this because
+        decided ids are exempt from it); each is resolved HERE so a dep
+        that committed after registration contributes its real executeAt
+        (its id alone is only a lower bound on where it executes)."""
+        hyp = txn_id.as_timestamp()
+        for t in self._wdeps[i]:
+            if t == txn_id:
+                continue
+            p = self._pos(t)
+            e = (self._eat_of(p) if p >= 0 and self._status[p].is_committed
+                 else t.as_timestamp())
+            if e > hyp:
+                return True
+        return False
+
+    def _filter_elided(self, found: List[TxnId], txn_id: TxnId
+                       ) -> List[TxnId]:
+        return [t for t in found
+                if not self._missing_explicable_by_elision(
+                    self._pos(t), txn_id)]
+
+    def started_after_without_witnessing_ids(self, txn_id: TxnId,
+                                             raw: bool = False
                                              ) -> List[TxnId]:
+        """`raw=True` returns the unsuppressed candidates (the device tier's
+        batched masks compute exactly these; suppression is a shared
+        host-side post-filter on both paths)."""
         found: List[TxnId] = []
         self.map_reduce_full(txn_id, txn_id.kind.witnessed_by(),
                              TestStartedAt.STARTED_AFTER, TestDep.WITHOUT,
                              TestStatus.IS_PROPOSED,
                              lambda t, at: found.append(t))
-        return found
+        return found if raw else self._filter_elided(found, txn_id)
 
     def accepted_or_committed_started_after_without_witnessing(
             self, txn_id: TxnId) -> bool:
         return bool(self.started_after_without_witnessing_ids(txn_id))
 
-    def executes_after_without_witnessing_ids(self, txn_id: TxnId
+    def executes_after_without_witnessing_ids(self, txn_id: TxnId,
+                                              raw: bool = False
                                               ) -> List[TxnId]:
         """hasStableExecutesAfterWithoutWitnessing (ANY started-at; the dep
-        test already restricts to executeAt > txn_id)."""
+        test already restricts to executeAt > txn_id).  Elision-shaped
+        omissions are inconclusive (see _missing_explicable_by_elision)."""
         found: List[TxnId] = []
         self.map_reduce_full(txn_id, txn_id.kind.witnessed_by(),
                              TestStartedAt.ANY, TestDep.WITHOUT,
                              TestStatus.IS_STABLE,
                              lambda t, at: found.append(t))
-        return found
+        return found if raw else self._filter_elided(found, txn_id)
 
     def committed_executes_after_without_witnessing(self, txn_id: TxnId
                                                     ) -> bool:

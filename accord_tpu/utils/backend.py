@@ -33,6 +33,10 @@ def resolve_platform(probe_timeout_s: float = 90.0) -> str:
     CPU and return a fallback label. Call before the first jax use."""
     platform = os.environ.get("JAX_PLATFORMS", "")
     if platform == "cpu":
+        # the env var alone does NOT stop the ambient site wrapper from
+        # initialising the (possibly dead-tunneled) device backend on first
+        # use — pin via jax.config too, exactly as the module docstring says
+        force_cpu()
         return "cpu"
     # the probe exercises the REAL wedge path — device compile + execute +
     # device->host pull — not just backend discovery: a flaky tunnel can

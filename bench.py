@@ -357,18 +357,27 @@ def _zipf_stack_fn(reps: int):
 
     @jax.jit
     def run(er, eer, ek, es, ekd, tr, twm, tkd, touches):
+        nwin = er.shape[0]
+
         def rep(carry, i):
-            # entry arrays roll on the window axis; txn arrays additionally
-            # on the batch axis (denies loop-invariant hoisting even for
-            # single-window buckets)
-            ent = [jnp.roll(a, i, axis=0) for a in (er, eer, ek, es, ekd)]
-            txn = [jnp.roll(jnp.roll(a, i, axis=0), i, axis=1)
-                   for a in (tr, twm, tkd, touches)]
+            # iteration skew WITHOUT materializing rolled copies of the
+            # stacked entry arrays (a [390, 1M] stack rolled per rep cost
+            # 2x1.53G per array and OOM'd the 16G chip): permute the WINDOW
+            # visit order via a rolled index vector and gather one window
+            # at a time inside the scan; txn arrays (small) additionally
+            # roll on the batch axis so the quadratic deps work still
+            # depends on the rep index even for single-window buckets
+            perm = jnp.roll(jnp.arange(nwin), i)
 
-            def body(c, xs):
-                return c, jnp.stack(_xla_window_body(*xs))     # [3] i32
+            def body(c, j):
+                ent = [jax.lax.dynamic_index_in_dim(a, j, 0, keepdims=False)
+                       for a in (er, eer, ek, es, ekd)]
+                txn = [jnp.roll(
+                    jax.lax.dynamic_index_in_dim(a, j, 0, keepdims=False),
+                    i, axis=0) for a in (tr, twm, tkd, touches)]
+                return c, jnp.stack(_xla_window_body(*(ent + txn)))  # [3]
 
-            _, per_win = jax.lax.scan(body, 0, tuple(ent + txn))
+            _, per_win = jax.lax.scan(body, 0, perm)
             return carry, jnp.stack([per_win[:, 0].sum(),
                                      per_win[:, 1].sum(),
                                      per_win[:, 2].max()])

@@ -190,8 +190,14 @@ class TestMapReduceFull:
         assert not cfk.committed_executes_after_without_witnessing(target)
         nowit = wid(30)
         cfk.update(nowit, InternalStatus.STABLE, execute_at=ts(30),
-                   dep_ids=[acc, stab])             # omits target
+                   dep_ids=[])     # omits target and every possible cover
         assert cfk.committed_executes_after_without_witnessing(target)
+        # but an omission alongside a dep on a write that executes after
+        # target is elision-explicable, NOT evidence (seed-16005
+        # regression; see TestElisionAwareRecoveryPredicates)
+        cfk.update(nowit, InternalStatus.STABLE, execute_at=ts(30),
+                   dep_ids=[stab])
+        assert not cfk.committed_executes_after_without_witnessing(target)
 
     def test_stable_started_before_and_witnessed(self):
         """A stable txn with id < probe < its executeAt whose deps contain
@@ -256,3 +262,96 @@ class TestPruneRedundant:
         cfk.update(b, InternalStatus.STABLE, execute_at=ts(20), dep_ids=[a])
         cfk.prune_redundant(wid(15))
         assert cfk.max_committed_write_before(ts(100)) == ts(20)
+
+
+class TestElisionAwareRecoveryPredicates:
+    """Regression for burn seed 16005 (round 3): recovery invalidated a
+    FAST-PATH-COMMITTED txn because a later txn's deps legitimately omitted
+    it via transitive elision (the deps calc elides committed entries below
+    the last committed-write bound) and the reject predicates read that
+    omission as proof the fast path was impossible.  An omission is
+    inconclusive when the candidate witnesses a locally-committed write
+    executing after the hypothesised fast-path timestamp — under the
+    hypothesis that write must order after the txn, transitively covering
+    it.  (The reference ships the same elision with an unproven-correctness
+    TODO, CommandsForKey.java:640; this guard is our correction.)"""
+
+    def _world(self, bound_status):
+        # w: the fast-path-committed txn under recovery (locally only
+        # PREACCEPTED — this replica was not in the commit's quorum)
+        # b: a later WRITE, `bound_status` here, executing after w
+        # x: later still, ACCEPTED with deps = [b] only (w elided)
+        cfk = CommandsForKey(Key(1))
+        w, b, x = wid(100), wid(200), wid(300)
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(b, bound_status, execute_at=ts(250), dep_ids=[w])
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=ts(300),
+                   dep_ids=[b])
+        return cfk, w, b, x
+
+    def test_omission_with_committed_bound_is_inconclusive(self):
+        cfk, w, b, x = self._world(InternalStatus.COMMITTED)
+        assert cfk.get(x).missing == (w,)  # divergence is recorded...
+        # ...but is NOT fast-path-reject evidence: x witnesses committed b,
+        # which executes after w
+        assert cfk.started_after_without_witnessing_ids(w) == []
+        # the raw (device-mask) enumeration still lists the candidate
+        assert cfk.started_after_without_witnessing_ids(w, raw=True) == [x]
+
+    def test_omission_with_uncommitted_bound_still_suppressed(self):
+        # the cover's LOCAL status is irrelevant: it may be committed at
+        # another replica, where it legally elided w.  Its id alone (above
+        # w) lower-bounds where it executes.
+        cfk, w, b, x = self._world(InternalStatus.ACCEPTED)
+        assert cfk.started_after_without_witnessing_ids(w) == []
+
+    def test_cover_committing_after_registration_suppresses(self):
+        # b (id BELOW w) slow-path commits to an executeAt above w only
+        # AFTER x registered its deps: the cover must be resolved at query
+        # time, not frozen at registration (review r3 finding)
+        cfk = CommandsForKey(Key(1))
+        b, w, x = wid(50), wid(100), wid(300)
+        cfk.update(b, InternalStatus.PREACCEPTED)
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=ts(300),
+                   dep_ids=[b])
+        # pre-commit: b's only known bound is its id (50 < 100) — evidence
+        assert cfk.started_after_without_witnessing_ids(w) == [x]
+        cfk.update(b, InternalStatus.COMMITTED, execute_at=ts(150),
+                   dep_ids=[])
+        # b now executes at 150 > w: the omission is elision-explicable
+        assert cfk.started_after_without_witnessing_ids(w) == []
+
+    def test_omission_with_only_earlier_write_deps_is_evidence(self):
+        # x's only write dep STARTS (and so executes) before w: no elision
+        # bound among its deps can cover w — full-strength evidence.
+        # (An UNCOMMITTED-here write dep with id above w still suppresses:
+        # it may be committed at another replica, where it legally elided
+        # w — the local status of the cover is irrelevant.)
+        cfk = CommandsForKey(Key(1))
+        early, w, x = wid(50), wid(100), wid(300)
+        cfk.update(early, InternalStatus.COMMITTED, execute_at=ts(50),
+                   dep_ids=[])
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=ts(300),
+                   dep_ids=[early])
+        assert cfk.started_after_without_witnessing_ids(w) == [x]
+
+    def test_omission_of_everything_is_evidence(self):
+        # x's deps omit BOTH w and every later write: no elision bound
+        # could explain that — full-strength evidence
+        cfk = CommandsForKey(Key(1))
+        w, b, x = wid(100), wid(200), wid(300)
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(b, InternalStatus.COMMITTED, execute_at=ts(250),
+                   dep_ids=[w])
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=ts(300),
+                   dep_ids=[])
+        assert cfk.started_after_without_witnessing_ids(w) == [x]
+
+    def test_stable_executes_after_variant_suppressed_too(self):
+        cfk, w, b, x = self._world(InternalStatus.COMMITTED)
+        cfk.update(x, InternalStatus.STABLE, execute_at=ts(300),
+                   dep_ids=[b])
+        assert cfk.executes_after_without_witnessing_ids(w) == []
+        assert cfk.executes_after_without_witnessing_ids(w, raw=True) == [x]

@@ -1,0 +1,171 @@
+"""Triage seed 16005: CommitInvalidate arriving at a STABLE command.
+
+Taps every protocol transition and coordinator decision touching the suspect
+txn, then replays the failing burn.
+"""
+import sys
+
+SUSPECT = "W[7,61143672,2]"
+SUSPECT2 = "W[7,70226780,3]"
+
+
+def tap(node_or_store, what, **fields):
+    import accord_tpu.sim.burn as B
+    t = CLUSTER[0].queue.clock.now_us / 1e6 if CLUSTER[0] else -1
+    print(f"{t:10.3f} {node_or_store} {what} "
+          + " ".join(f"{k}={v}" for k, v in fields.items()), flush=True)
+
+
+CLUSTER = [None]
+
+
+def main():
+    from accord_tpu.local import commands as C
+    from accord_tpu.coordinate import recover as R
+    from accord_tpu.coordinate import invalidate as I
+    from accord_tpu.sim.burn import BurnRun
+
+    def match(txn_id):
+        return repr(txn_id) in (SUSPECT, SUSPECT2)
+
+    def describe_deps(args, kw):
+        from accord_tpu.primitives.deps import Deps
+        out = []
+        for v in list(args) + list(kw.values()):
+            if isinstance(v, Deps):
+                ids = [repr(t) for t in v.sorted_txn_ids()]
+                out.append({"has_W": SUSPECT in ids,
+                            "n": len(ids),
+                            "ids": [i for i in ids if "[7," in i][:12]})
+        return out
+
+    # ---- command-store transitions ----
+    for name in ("preaccept", "recover", "accept", "accept_invalidate",
+                 "preaccept_invalidate", "commit", "precommit",
+                 "commit_invalidate", "apply"):
+        orig = getattr(C, name)
+
+        def wrap(orig=orig, name=name):
+            def inner(safe_store, txn_id, *a, **kw):
+                if match(txn_id):
+                    cmd = safe_store.store.commands.get(txn_id)
+                    before = cmd.save_status.name if cmd else "NONE"
+                    out = orig(safe_store, txn_id, *a, **kw)
+                    cmd = safe_store.store.commands.get(txn_id)
+                    after = cmd.save_status.name if cmd else "NONE"
+                    extra = {}
+                    if cmd is not None:
+                        extra = dict(prom=cmd.promised, acc=cmd.accepted_ballot,
+                                     at=cmd.execute_at)
+                    deps_info = describe_deps(a, kw)
+                    if deps_info:
+                        extra["deps"] = deps_info
+                    tap(f"n{safe_store.store.node.id}st{safe_store.store.id}",
+                        f"{name}({txn_id!r})", before=before, after=after,
+                        out=(out if not isinstance(out, tuple) else out[0]),
+                        **extra)
+                    return out
+                return orig(safe_store, txn_id, *a, **kw)
+            return inner
+        setattr(C, name, wrap())
+
+    # re-bind names imported into message modules
+    import accord_tpu.messages.preaccept as MP
+    import accord_tpu.messages.accept as MA
+    import accord_tpu.messages.commit as MC
+    import accord_tpu.messages.apply_msg as MAp
+    import accord_tpu.messages.recover as MR
+    for mod in (MP, MA, MC, MAp, MR):
+        mod.C = C
+
+    # ---- recovery coordinator decisions ----
+    orig_recover = R.Recover._recover
+    def rec(self):
+        if match(self.txn_id):
+            oks = {f: (ok.status.name, str(ok.accepted_ballot),
+                       str(ok.execute_at), ok.rejects_fast_path,
+                       str(ok.earlier_no_witness.sorted_txn_ids()
+                           if not ok.earlier_no_witness.is_empty else []))
+                   for f, ok in self.oks.items()}
+            tap(f"n{self.node.id}", "Recover._recover", ballot=self.ballot,
+                oks=oks, tracker_rejects=self.tracker.rejects_fast_path())
+        return orig_recover(self)
+    R.Recover._recover = rec
+
+    for meth in ("_invalidate", "_commit_invalidate", "_propose", "_execute",
+                 "_persist_outcome", "_retry", "_await_commits", "_fail",
+                 "_succeed"):
+        orig = getattr(R.Recover, meth)
+
+        def wrapm(orig=orig, meth=meth):
+            def inner(self, *a, **kw):
+                if match(self.txn_id):
+                    tap(f"n{self.node.id}", f"Recover{meth}",
+                        ballot=self.ballot, done=self.done,
+                        arg=(repr(a[0])[:120] if a else ""))
+                return orig(self, *a, **kw)
+            return inner
+        setattr(R.Recover, meth, wrapm())
+
+    # ---- name the fast-path-reject evidence ----
+    from accord_tpu.local.store import SafeCommandStore as SCS
+    orig_rfp = SCS.rejects_fast_path
+
+    def rfp(self, txn_id, participants):
+        out = orig_rfp(self, txn_id, participants)
+        if match(txn_id) and out:
+            detail = {}
+            for cfk in self._participant_cfks(participants):
+                sa = cfk.started_after_without_witnessing_ids(txn_id)
+                ea = cfk.executes_after_without_witnessing_ids(txn_id)
+                if sa or ea:
+                    detail[repr(cfk.key)] = {
+                        "started_after_no_witness": [repr(t) for t in sa],
+                        "executes_after_no_witness": [repr(t) for t in ea]}
+            tap(f"n{self.store.node.id}st{self.store.id}",
+                "rejects_fast_path=True", detail=detail)
+        return out
+    SCS.rejects_fast_path = rfp
+
+    orig_ci = I.commit_invalidate
+    def ci(node, txn_id, route):
+        if match(txn_id):
+            tap(f"n{node.id}", "coordinate.commit_invalidate(fanout)")
+        return orig_ci(node, txn_id, route)
+    I.commit_invalidate = ci
+    R.commit_invalidate = ci
+
+    for meth in ("start", "_promised", "_fail"):
+        if hasattr(I.ProposeInvalidate, meth):
+            orig = getattr(I.ProposeInvalidate, meth)
+
+            def wrapp(orig=orig, meth=meth):
+                def inner(self, *a, **kw):
+                    if match(self.txn_id):
+                        tap(f"n{self.node.id}", f"ProposeInvalidate{meth}",
+                            ballot=getattr(self, 'ballot', None))
+                    return orig(self, *a, **kw)
+                return inner
+            setattr(I.ProposeInvalidate, meth, wrapp())
+
+    run = BurnRun(16005, 400, nodes=3, keys=12, n_shards=2, drop_prob=0.22,
+                  partitions=True, clock_drift=True, num_command_stores=4,
+                  store_factory=None)
+    # delayed stores like the CLI
+    from accord_tpu.sim.delayed_store import DelayedCommandStore
+    from accord_tpu.utils.random_source import RandomSource
+    run = BurnRun(16005, 400, nodes=3, keys=12, n_shards=2, drop_prob=0.22,
+                  partitions=True, clock_drift=True, num_command_stores=4,
+                  store_factory=DelayedCommandStore.factory(
+                      RandomSource(16005 ^ 0x5D5D)))
+    CLUSTER[0] = run.cluster
+    try:
+        run.run()
+        print("UNEXPECTED: run passed")
+    except Exception as e:
+        print(f"FAILED as expected: {e}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
