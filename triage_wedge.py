@@ -1,0 +1,113 @@
+"""Replay the seed-15000-chain lost-append wedge and dump the blocking chain.
+
+See SOAK_NOTES.md — run the chained seeds through one shared DelayedCommandStore
+RandomSource; seed 15003 loses an acked append for key 1 (value 19).
+"""
+import sys
+import traceback
+
+from accord_tpu.sim.burn import BurnRun
+from accord_tpu.sim.delayed_store import DelayedCommandStore
+from accord_tpu.utils.random_source import RandomSource
+from accord_tpu.primitives.timestamp import TxnId
+
+
+def dump_chain(cluster, suspect_repr):
+    """Walk every store on every node; dump the suspect's waiting_on and then
+    the full blocking chain from it."""
+    # find the suspect txn id by repr match
+    suspect = None
+    for node in cluster.nodes.values():
+        for store in node.command_stores.stores:
+            for txn_id in store.commands:
+                if repr(txn_id) == suspect_repr:
+                    suspect = txn_id
+                    break
+            if suspect:
+                break
+        if suspect:
+            break
+    if suspect is None:
+        print("suspect not found by repr; dumping all PRE_APPLIED-but-unapplied")
+        for node in cluster.nodes.values():
+            for store in node.command_stores.stores:
+                for txn_id, cmd in store.commands.items():
+                    if cmd.save_status.name.startswith("PRE_APPLIED"):
+                        print(node.id, store, txn_id, cmd.save_status.name)
+        return
+
+    # root blocker forensics
+    root_repr = "W[1,1070,1]"
+    for node in cluster.nodes.values():
+        coords = {repr(t): v for t, v in node.coordinating.items()}
+        print(f"n{node.id} coordinating: {sorted(coords)}")
+        if root_repr in coords:
+            res = coords[root_repr]
+            print(f"   root-blocker future: done={getattr(res, 'is_done', '?')}"
+                  f" cbs={len(getattr(res, '_callbacks', []) or [])}")
+        for store in node.command_stores.stores:
+            pl = store.progress_log
+            for tid, st in list(getattr(pl, "blocked", {}).items()):
+                if repr(tid) == root_repr:
+                    print(f"   n{node.id} st{store.id} blocked[{tid!r}]: "
+                          f"until={st.blocked_until} attempts={st.attempts} "
+                          f"since={st.since_s:.1f} route={st.route} "
+                          f"parts={st.participants}")
+            cmd = store.commands.get(
+                next((t for t in store.commands if repr(t) == root_repr), None))
+            if cmd is not None:
+                print(f"   n{node.id} st{store.id} root cmd route={cmd.route}")
+
+    seen = set()
+    frontier = [suspect]
+    while frontier:
+        tid = frontier.pop()
+        if tid in seen:
+            continue
+        seen.add(tid)
+        print(f"=== chain node {tid!r} ===")
+        for node in cluster.nodes.values():
+            for store in node.command_stores.stores:
+                cmd = store.commands.get(tid)
+                if cmd is None:
+                    continue
+                wo = cmd.waiting_on
+                print(f"  n{node.id} st{store.id}: {cmd.save_status.name} "
+                      f"at={cmd.execute_at} dur={cmd.durability.name} "
+                      f"prom={cmd.promised} acc={cmd.accepted_ballot}")
+                if wo is not None and wo.is_waiting:
+                    wids = wo.waiting_ids()
+                    wkeys = wo.waiting_key_list()
+                    print(f"      waiting_on txns={wids} keys={wkeys}")
+                    frontier.extend(wids)
+                    # for waiting keys, look at the CFK to find what blocks
+                    for k in wkeys:
+                        cfk = store.cfks.get(k) if hasattr(store, "cfks") else None
+                        if cfk is None and hasattr(store, "cfk"):
+                            try:
+                                cfk = store.cfk(k)
+                            except Exception:
+                                cfk = None
+                        if cfk is not None:
+                            print(f"      CFK[{k}]: {cfk!r}")
+
+
+def main():
+    factory = DelayedCommandStore.factory(RandomSource(15000 ^ 0x5D5D))
+    for seed in (15000, 15001, 15002, 15003):
+        run = BurnRun(seed, 400, nodes=3, keys=12, n_shards=2, drop_prob=0.22,
+                      partitions=True, clock_drift=True, num_command_stores=4,
+                      store_factory=factory)
+        try:
+            run.run()
+            print(f"seed {seed}: OK")
+        except Exception as e:
+            print(f"seed {seed}: FAILED: {e}")
+            traceback.print_exc(limit=3)
+            dump_chain(run.cluster, "W[1,6088562,1]")
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
