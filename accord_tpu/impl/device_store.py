@@ -177,13 +177,10 @@ class DeviceSafeCommandStore(SafeCommandStore):
             or self._any_unsuppressed(served_b, txn_id)
 
     def _any_unsuppressed(self, served: Dict, txn_id: TxnId) -> bool:
-        for key, ids in served.items():
-            cfk = self.cfk(key)
-            for t in ids:
-                if not cfk._missing_explicable_by_elision(cfk._pos(t),
-                                                          txn_id):
-                    return True
-        return False
+        # one implementation of the filter: CommandsForKey._filter_elided
+        # (the same one the scalar predicates apply)
+        return any(self.cfk(key)._filter_elided(list(ids), txn_id)
+                   for key, ids in served.items())
 
     def _earlier_committed_witness_keys(self, txn_id, participants,
                                         builder) -> None:

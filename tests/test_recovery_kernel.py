@@ -24,9 +24,13 @@ def scalar_predicates(cfks, probe, keys):
     no_witness = set()
     for k in keys:
         cfk = by_key[k]
-        rejects_a |= \
-            cfk.accepted_or_committed_started_after_without_witnessing(probe)
-        rejects_b |= cfk.committed_executes_after_without_witnessing(probe)
+        # the kernel's contract is the RAW candidate enumeration; elision
+        # suppression is a host-side post-filter shared by both paths
+        # (device_store._any_unsuppressed)
+        rejects_a |= bool(
+            cfk.started_after_without_witnessing_ids(probe, raw=True))
+        rejects_b |= bool(
+            cfk.executes_after_without_witnessing_ids(probe, raw=True))
         witness.update(cfk.stable_started_before_and_witnessed(probe))
         no_witness.update(cfk.accepted_started_before_without_witnessing(probe))
     return rejects_a, rejects_b, sorted(witness), sorted(no_witness)
@@ -54,10 +58,24 @@ def test_batched_recovery_matches_scalar(seed):
     rb = np.asarray(rb).any(axis=1)
     cw, anw = np.asarray(cw), np.asarray(anw)
 
+    by_key = {c.key: c for c in cfks}
     for i, (probe, keys) in enumerate(probes):
         want_ra, want_rb, want_w, want_nw = scalar_predicates(
             cfks, probe, keys)
         assert bool(ra[i]) == want_ra, (i, probe, "rejects_a")
+        # composed decision: raw kernel candidates + the shared elision
+        # post-filter must equal the FILTERED scalar predicates — the
+        # decision the protocol path actually acts on
+        composed = any(
+            by_key[k]._filter_elided(
+                by_key[k].started_after_without_witnessing_ids(probe,
+                                                               raw=True),
+                probe)
+            for k in keys)
+        want_filtered = any(
+            bool(by_key[k].started_after_without_witnessing_ids(probe))
+            for k in keys)
+        assert composed == want_filtered, (i, probe, "composed rejects_a")
         assert bool(rb[i]) == want_rb, (i, probe, "rejects_b")
         assert enc.decode_ids(cw[i]) == want_w, (i, probe, "witness")
         assert enc.decode_ids(anw[i]) == want_nw, (i, probe, "no_witness")
