@@ -37,6 +37,18 @@ from accord_tpu.utils.random_source import RandomSource
 _LEN = struct.Struct(">I")
 
 
+def _build_list_txn(read_tokens, appends: Dict[int, int]) -> Txn:
+    """List-register read/append txn (shared by the in-process and wire
+    client paths)."""
+    keys = Keys.of(*(set(read_tokens) | set(appends)))
+    return Txn(
+        TxnKind.WRITE if appends else TxnKind.READ, keys,
+        read=ListRead(Keys.of(*read_tokens)) if read_tokens else None,
+        query=ListQuery(),
+        update=ListUpdate({Key(t): v for t, v in appends.items()})
+        if appends else None)
+
+
 def _send_frame(sock: socket.socket, obj: dict) -> None:
     data = json.dumps(obj).encode()
     sock.sendall(_LEN.pack(len(data)) + data)
@@ -116,6 +128,10 @@ class _PeerWriter:
                 if self.sock is None:
                     self.sock = socket.create_connection(
                         self.host.peers[self.to], timeout=5.0)
+                    # consensus rounds are small request/reply frames:
+                    # Nagle + delayed-ACK otherwise stalls each ~40ms
+                    self.sock.setsockopt(socket.IPPROTO_TCP,
+                                         socket.TCP_NODELAY, 1)
                 _send_frame(self.sock, frame)
             except OSError:
                 if self.sock is not None:
@@ -154,7 +170,10 @@ class TcpHost:
         # the OS may have assigned the port (port 0): record reality
         self.peers[my_id] = self.server.getsockname()
 
-        ids = sorted(self.peers)
+        # non-positive ids are CLIENT endpoints: they share the frame
+        # transport (their replies travel as ordinary frames to their own
+        # listening socket) but are not cluster members
+        ids = sorted(i for i in self.peers if i > 0)
         rf = rf if rf is not None else min(3, len(ids))
         topology = build_topology(ids, rf, n_shards)
 
@@ -230,19 +249,51 @@ class TcpHost:
                 elif kind == "call":
                     item()
             except Exception as e:  # noqa: BLE001 — one bad frame/callback
-                # must never kill the node's only loop thread
+                # must never kill the node's only loop thread.  stderr: the
+                # parent reads stdout exactly once (the ready line) — a
+                # full stdout pipe would block this, the node's ONLY thread
+                import sys as _sys
                 print(f"tcp host n{self.my_id} dispatch error: {e!r}",
-                      flush=True)
+                      file=_sys.stderr, flush=True)
             self.scheduler.run_due()
 
     def _dispatch(self, frame: dict) -> None:
         body = frame["body"]
         from_id = frame["src"]
+        kind = body.get("type")
+        if kind == "submit":
+            # client txn over the wire (multi-process bench/harness path)
+            self._client_submit(from_id, body)
+            return
+        if kind == "stop":
+            self.running = False
+            return
         payload = decode_message(body["payload"])
         if "in_reply_to" in body:
             self.sink.deliver_reply(body["in_reply_to"], from_id, payload)
         else:
             self.node.receive(payload, from_id, body.get("msg_id"))
+
+    def _client_submit(self, from_id: int, body: dict) -> None:
+        req = body.get("req")
+
+        def done(value, failure):
+            reads = {}
+            if failure is None and value is not None:
+                reads = {k.token: list(v)
+                         for k, v in value.read_values.items()}
+            self.emit(from_id, {"type": "submit_reply", "req": req,
+                                "ok": failure is None,
+                                "error": repr(failure) if failure else None,
+                                "reads": reads})
+
+        try:
+            read_tokens = body.get("reads", [])
+            appends = {int(t): v for t, v in body.get("appends", {}).items()}
+            txn = _build_list_txn(read_tokens, appends)
+            self.node.coordinate(txn).add_callback(done)
+        except BaseException as e:  # noqa: BLE001
+            done(None, e)
 
     # -------------------------------------------------------------- client --
     def submit(self, read_tokens, appends: Dict[int, int]) -> SubmitResult:
@@ -251,15 +302,7 @@ class TcpHost:
 
         def run():
             try:
-                keys = Keys.of(*(set(read_tokens) | set(appends)))
-                txn = Txn(
-                    TxnKind.WRITE if appends else TxnKind.READ, keys,
-                    read=ListRead(Keys.of(*read_tokens))
-                    if read_tokens else None,
-                    query=ListQuery(),
-                    update=ListUpdate({Key(t): v
-                                       for t, v in appends.items()})
-                    if appends else None)
+                txn = _build_list_txn(read_tokens, appends)
                 self.node.coordinate(txn).add_callback(result._complete)
             except BaseException as e:  # noqa: BLE001 — the client must see
                 result._complete(None, e)  # the real error, not a timeout
@@ -277,3 +320,138 @@ class TcpHost:
             for writer in self._out.values():
                 writer.close()
             self._out.clear()
+
+
+# --------------------------------------------------- multi-process cluster --
+
+def _free_ports(n: int):
+    """Pre-select n distinct free localhost ports (bind-then-close; the
+    tiny reuse race is acceptable for local harnesses)."""
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class TcpClusterClient:
+    """Client endpoint (pseudo-node 0) for a cluster of OS-process TcpHost
+    nodes: spawns the workers, speaks the same length-prefixed frame codec,
+    and collects submit replies — SURVEY §5.8's comm backend driven
+    end-to-end over real sockets with one GIL per node."""
+
+    def __init__(self, n_nodes: int = 3, n_shards: int = 4):
+        import subprocess
+        import sys as _sys
+        ports = _free_ports(n_nodes + 1)
+        self.peers = {i: ("127.0.0.1", ports[i]) for i in range(n_nodes + 1)}
+        self.server = socket.create_server(self.peers[0], reuse_port=False)
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.running = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        self.procs = []
+        spec_peers = {str(i): list(p) for i, p in self.peers.items()}
+        try:
+            for i in range(1, n_nodes + 1):
+                spec = json.dumps({"id": i, "peers": spec_peers,
+                                   "n_shards": n_shards})
+                self.procs.append(subprocess.Popen(
+                    [_sys.executable, "-m", "accord_tpu.host.tcp", spec],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True))
+            for p in self.procs:
+                line = p.stdout.readline()  # ready marker
+                assert line.strip(), "tcp worker failed to start"
+        except BaseException:
+            for p in self.procs:  # a failed spawn must not orphan the rest
+                p.kill()
+            raise
+        self._out: Dict[int, socket.socket] = {}
+
+    def _accept_loop(self) -> None:
+        while self.running:
+            try:
+                conn, _ = self.server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        try:
+            while self.running:
+                frame = _recv_frame(conn)
+                if frame is None:
+                    return
+                self.inbox.put(frame)
+        except (OSError, ValueError):
+            return
+
+    def _send(self, to: int, body: dict) -> None:
+        sock = self._out.get(to)
+        if sock is None:
+            sock = self._out[to] = socket.create_connection(self.peers[to],
+                                                            timeout=10.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_frame(sock, {"src": 0, "body": body})
+
+    def submit(self, to: int, reads, appends: Dict[int, int], req) -> None:
+        self._send(to, {"type": "submit", "req": req, "reads": list(reads),
+                        "appends": {str(k): v for k, v in appends.items()}})
+
+    def recv(self, timeout_s: float = 30.0) -> Optional[dict]:
+        try:
+            return self.inbox.get(timeout=timeout_s)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        for i in range(1, len(self.procs) + 1):
+            try:
+                self._send(i, {"type": "stop"})
+            except OSError:
+                pass
+        self.running = False
+        try:
+            self.server.close()
+        except OSError:
+            pass
+        for s in self._out.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        for p in self.procs:
+            try:
+                p.wait(timeout=5.0)
+            except Exception:
+                p.kill()
+
+
+def main() -> None:
+    """Worker-process entry: python -m accord_tpu.host.tcp '<spec json>'
+    with spec = {"id": N, "peers": {"0": [host, port], ...}, "n_shards": S}.
+    Prints one ready line (its realised port), serves until a stop frame."""
+    import sys as _sys
+    spec = json.loads(_sys.argv[1])
+    peers = {int(k): tuple(v) for k, v in spec["peers"].items()}
+    host = TcpHost(spec["id"], peers, n_shards=spec.get("n_shards", 4))
+    print(json.dumps({"id": spec["id"],
+                      "port": host.peers[spec["id"]][1]}), flush=True)
+
+    def parent_watch():
+        # the spawner holds our stdin pipe: EOF means it is gone — exit
+        # rather than serve forever as an orphan
+        _sys.stdin.read()
+        host.running = False
+
+    threading.Thread(target=parent_watch, daemon=True).start()
+    try:
+        while host.running:
+            time.sleep(0.05)
+    finally:
+        host.close()
+
+
+if __name__ == "__main__":
+    main()

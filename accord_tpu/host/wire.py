@@ -52,6 +52,21 @@ _MODULES = [
 _CLASSES: Dict[str, Type] = {}
 _ENUMS: Dict[str, Type] = {}
 
+# compact fast paths for the primitives that dominate every frame (a deps
+# list is hundreds of TxnIds; the structural walk also serialises cached
+# comparison slots).  Exact-type dispatch: subclasses fall through to the
+# structural codec.
+from accord_tpu.primitives.keys import (Key as _Key, Keys as _Keys,
+                                        RoutingKey as _RoutingKey,
+                                        RoutingKeys as _RoutingKeys)
+from accord_tpu.primitives.timestamp import (Ballot as _Ballot,
+                                             Timestamp as _Timestamp,
+                                             TxnId as _TxnId)
+
+_TS_TAGS = {_Timestamp: "$T", _TxnId: "$I", _Ballot: "$B"}
+_TS_DECODE = {"$T": _Timestamp, "$I": _TxnId, "$B": _Ballot}
+_SLOTS_CACHE: Dict[Type, list] = {}
+
 
 def _registry() -> Dict[str, Type]:
     if _CLASSES:
@@ -69,13 +84,32 @@ def _registry() -> Dict[str, Type]:
 
 
 def _slots_of(cls: Type):
-    out = []
-    for klass in cls.__mro__:
-        out.extend(getattr(klass, "__slots__", ()))
+    out = _SLOTS_CACHE.get(cls)
+    if out is None:
+        out = []
+        for klass in cls.__mro__:
+            out.extend(getattr(klass, "__slots__", ()))
+        _SLOTS_CACHE[cls] = out
     return out
 
 
 def encode(obj: Any) -> Any:
+    tag = _TS_TAGS.get(type(obj))
+    if tag is not None:
+        msb, lsb, node = obj.pack()
+        return {tag: [msb, lsb, node]}
+    if type(obj) is _Key:
+        return {"$K": obj.token}
+    if type(obj) is _RoutingKey:
+        return {"$RK": obj.token}
+    if type(obj) is _Keys and all(type(k) is _Key for k in obj):
+        # hosts may subclass Key for richer identity — those fall through
+        # to the structural codec (loud if unregistered) instead of being
+        # silently flattened to plain tokens
+        return {"$Ks": [k.token for k in obj]}
+    if type(obj) is _RoutingKeys \
+            and all(type(k) is _RoutingKey for k in obj):
+        return {"$RKs": [k.token for k in obj]}
     if isinstance(obj, enum.Enum):  # before int: IntEnum is an int
         return {"$e": type(obj).__name__, "v": encode(obj.value)}
     if obj is None or isinstance(obj, (bool, int, float, str)):
@@ -83,6 +117,9 @@ def encode(obj: Any) -> Any:
     if isinstance(obj, list):
         return [encode(x) for x in obj]
     if isinstance(obj, tuple):
+        # deps CSR offsets/ids are long int tuples: skip per-element calls
+        if all(type(x) is int for x in obj):
+            return {"$t": list(obj)}
         return {"$t": [encode(x) for x in obj]}
     if isinstance(obj, (set, frozenset)):
         return {"$s": [encode(x) for x in obj]}
@@ -110,8 +147,25 @@ def decode(data: Any) -> Any:
     if isinstance(data, list):
         return [decode(x) for x in data]
     assert isinstance(data, dict), data
+    if len(data) == 1:
+        ((k, v),) = data.items()
+        cls = _TS_DECODE.get(k)
+        if cls is not None:
+            return cls.unpack(v[0], v[1], v[2])
+        if k == "$K":
+            return _Key(v)
+        if k == "$RK":
+            return _RoutingKey(v)
+        if k == "$Ks":
+            return _Keys([_Key(t) for t in v], _presorted=True)
+        if k == "$RKs":
+            return _RoutingKeys([_RoutingKey(t) for t in v],
+                                _presorted=True)
     if "$t" in data:
-        return tuple(decode(x) for x in data["$t"])
+        t = data["$t"]
+        if all(type(x) is int for x in t):
+            return tuple(t)
+        return tuple(decode(x) for x in t)
     if "$s" in data:
         return frozenset(decode(x) for x in data["$s"])
     if "$d" in data:

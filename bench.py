@@ -600,6 +600,105 @@ def bench_maelstrom(nodes=3, keys=100, n_ops=400, single_key=True,
     }))
 
 
+def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16):
+    """BASELINE row: black-box throughput over the REAL-SOCKET transport —
+    one OS process (one GIL) per node, inter-node traffic on direct TCP
+    connections (no relay bus, unlike the Maelstrom harness where every
+    message funnels through the single-threaded stdio router), strict
+    serializability verified post-run.  CPU-only."""
+    import random
+
+    from accord_tpu.host.tcp import TcpClusterClient
+    from accord_tpu.sim.verify import (Observation,
+                                       StrictSerializabilityVerifier)
+
+    rng = random.Random(seed)
+    c = TcpClusterClient(n_nodes=nodes)
+    obs = []
+    try:
+        state = {"value": 0, "submitted": 0}
+        pending = {}
+
+        def submit_one():
+            to = 1 + rng.randrange(nodes)
+            k = rng.randrange(keys)
+            reads, appends = [k], {}
+            if rng.random() < 0.7:
+                state["value"] += 1
+                appends[k] = state["value"]
+            if rng.random() < 0.3:
+                k2 = rng.randrange(keys)
+                if k2 not in appends:
+                    state["value"] += 1
+                    appends[k2] = state["value"]
+            req = state["submitted"]
+            state["submitted"] += 1
+            pending[req] = (time.monotonic(), dict(appends), to)
+            c.submit(to, reads, appends, req)
+
+        t0 = time.perf_counter()
+        for _ in range(min(pipeline, n_ops)):
+            submit_one()
+        acked = completed = 0
+        deadline = time.monotonic() + 300
+        while completed < n_ops and time.monotonic() < deadline:
+            frame = c.recv(5.0)
+            if frame is None:
+                continue
+            body = frame.get("body", {})
+            if body.get("type") != "submit_reply":
+                continue
+            completed += 1
+            start, appends, to = pending.pop(body["req"])
+            if body["ok"]:
+                acked += 1
+                obs.append(Observation(
+                    f"txn{body['req']}@n{to}",
+                    {int(t): tuple(v) for t, v in body["reads"].items()},
+                    appends, int(start * 1e6),
+                    int(time.monotonic() * 1e6)))
+            if state["submitted"] < n_ops:
+                submit_one()
+        dt = time.perf_counter() - t0
+
+        # final histories (not timed): chunked read-only txns
+        final = {}
+        req = 10 ** 9
+        for lo in range(0, keys, 20):
+            chunk = list(range(lo, min(lo + 20, keys)))
+            c.submit(1, chunk, {}, req)
+            while True:
+                frame = c.recv(30.0)
+                assert frame is not None, "final read timed out"
+                body = frame.get("body", {})
+                if body.get("type") == "submit_reply" \
+                        and body.get("req") == req:
+                    assert body["ok"], body
+                    for t, v in body["reads"].items():
+                        final[int(t)] = tuple(v)
+                    break
+            req += 1
+        verifier = StrictSerializabilityVerifier()
+        for o in obs:
+            verifier.observe(o)
+        verifier.verify(final)  # raises on any anomaly
+    finally:
+        c.close()
+    assert acked > 0.9 * n_ops, (acked, completed)
+    print(json.dumps({
+        "metric": "tcp_host_txn_per_sec",
+        "value": round(acked / dt, 1),
+        "unit": "txn/s",
+        "workload": "lin-kv read+append mix, direct-socket cluster",
+        "nodes": nodes,
+        "keys": keys,
+        "ops": completed,
+        "acked": acked,
+        "wall_seconds": round(dt, 2),
+        "verified": "strict-serializable",
+    }))
+
+
 # ---------------------------------------------------------------- tpcc -----
 
 def _tpcc_resolve_core():
@@ -843,12 +942,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="default",
                     choices=["default", "zipf1m", "rangestress", "tpcc",
-                             "maelstrom", "maelstrom-rw"])
+                             "maelstrom", "maelstrom-rw", "tcp"])
     ap.add_argument("--verify", action="store_true",
                     help="cross-check device window counts against a host "
                          "re-derivation (zipf1m)")
     ns = ap.parse_args()
-    if ns.config not in ("maelstrom", "maelstrom-rw"):
+    if ns.config not in ("maelstrom", "maelstrom-rw", "tcp"):
         # device-using configs probe the (possibly dead-tunneled) backend
         # first; host-only configs never touch the chip
         from accord_tpu.utils.backend import resolve_platform
@@ -863,6 +962,8 @@ def main():
         bench_maelstrom(nodes=3, keys=100, single_key=True)
     elif ns.config == "maelstrom-rw":
         bench_maelstrom(nodes=5, keys=20, single_key=False)
+    elif ns.config == "tcp":
+        bench_tcp(nodes=3, keys=100)
     else:
         bench_rangestress()
 
