@@ -283,6 +283,9 @@ def main(argv=None) -> int:
     parser.add_argument("-s", "--seed", type=int, default=0)
     parser.add_argument("-o", "--ops", type=int, default=200)
     parser.add_argument("--nodes", type=int, default=3)
+    parser.add_argument("--rf", type=int, default=None,
+                        help="replication factor (< nodes = partial "
+                             "replication; default full)")
     parser.add_argument("--keys", type=int, default=20)
     parser.add_argument("--shards", type=int, default=4)
     parser.add_argument("--drop", type=float, default=0.0)
@@ -338,6 +341,7 @@ def main(argv=None) -> int:
         seed = args.seed + i
         store_factory = make_store_factory(seed)
         run = BurnRun(seed, args.ops, nodes=args.nodes, keys=args.keys,
+                      rf=args.rf,
                       n_shards=args.shards, drop_prob=args.drop,
                       store_factory=store_factory,
                       num_command_stores=args.stores,
