@@ -180,3 +180,41 @@ class TestBlackBoxCluster:
             assert checked == stats["acked"]
         finally:
             runner.close()
+
+
+class TestWireFastPaths:
+    """The compact encodings for hot primitives (r3: packed-int timestamps,
+    token arrays for key sets, int-tuple passthrough) and their guardrails."""
+
+    def test_compact_forms(self):
+        from accord_tpu.host.wire import encode
+        t = tid(9)
+        enc = encode(t)
+        assert set(enc) == {"$I"} and len(enc["$I"]) == 3
+        assert roundtrip(t) == t and type(roundtrip(t)) is type(t)
+        b = Ballot(1, 5, 0, 2)
+        assert set(encode(b)) == {"$B"} and roundtrip(b) == b
+        ts = Timestamp(1, 2, 3, 4)
+        assert set(encode(ts)) == {"$T"} and roundtrip(ts) == ts
+        ks = Keys.of(1, 2, 3)
+        assert set(encode(ks)) == {"$Ks"}
+        back = roundtrip(ks)
+        assert back == ks and all(type(k) is Key for k in back)
+        ints = (3, 1, 4, 1, 5)
+        assert encode(ints) == {"$t": [3, 1, 4, 1, 5]}
+        assert roundtrip(ints) == ints
+
+    def test_key_subclass_falls_through_loudly(self):
+        """Hosts may subclass Key for richer identity; the compact token
+        array must NOT silently flatten those — unregistered subclasses
+        keep failing loudly through the structural codec."""
+        import pytest
+        from accord_tpu.host.wire import encode
+
+        class FatKey(Key):
+            pass
+
+        with pytest.raises(TypeError, match="unregistered"):
+            encode(Keys([FatKey(1), Key(2)]))
+        with pytest.raises(TypeError, match="unregistered"):
+            encode(FatKey(1))
