@@ -682,10 +682,14 @@ class CommandsForKey:
         per-store RedundantBefore) are already reflected in local state
         (snapshot or GC) and never block."""
         out: List[Tuple[TxnId, bool]] = []
-        for i, t in enumerate(self._ids):
-            if t >= waiting_until or t == exclude:
-                continue
-            if self.redundant_before is not None and t < self.redundant_before:
+        # ids are sorted: only the prefix strictly below waiting_until can
+        # block, and everything below the redundancy watermark never does
+        lo = (bisect_left(self._ids, self.redundant_before)
+              if self.redundant_before is not None else 0)
+        hi = bisect_left(self._ids, waiting_until)
+        for i in range(lo, hi):
+            t = self._ids[i]
+            if t == exclude:
                 continue
             st = self._status[i]
             if not t.is_visible or st == InternalStatus.TRANSITIVELY_KNOWN:
