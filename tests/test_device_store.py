@@ -156,3 +156,19 @@ def test_backend_death_falls_back_to_scalar(monkeypatch):
     with pytest.raises(RuntimeError, match="axon"):
         BurnRun(612, 40, store_factory=DeviceCommandStore.factory(
             flush_window_us=200, verify=True)).run()
+
+
+def test_deep_flush_windows_stay_verified():
+    """Wide flush windows + high client concurrency produce genuinely
+    multi-txn device batches (the shipped soaks topped out at 2-3); every
+    batched window must still verify inline against the scalar oracle."""
+    factory = DeviceCommandStore.factory(flush_window_us=4000, verify=True)
+    run = BurnRun(33002, 80, concurrency=24, store_factory=factory,
+                  drop_prob=0.05)
+    stats = run.run()
+    mb = max(getattr(s, "device_max_batch", 0)
+             for n in run.cluster.nodes.values()
+             for s in n.command_stores.stores)
+    assert stats.pending == 0
+    assert stats.acks > 0
+    assert mb >= 4, f"window never batched deeply (max_batch={mb})"
