@@ -221,7 +221,10 @@ class Ranges:
         if not _normalized:
             rs = self._normalize(rs)
         self._ranges: Tuple[Range, ...] = tuple(rs)
-        self._starts: Tuple[int, ...] = tuple(r.start for r in rs)
+        # bisect index for _find_containing, built lazily: Ranges are
+        # constructed ~200k times per hostile burn (slices/intersections)
+        # and most are never point-probed
+        self._starts: Optional[Tuple[int, ...]] = None
 
     @staticmethod
     def _normalize(rs: List[Range]) -> List[Range]:
@@ -273,7 +276,10 @@ class Ranges:
         return self._find_containing(token) is not None
 
     def _find_containing(self, token: int) -> Optional[Range]:
-        i = bisect.bisect_right(self._starts, token) - 1
+        starts = self._starts
+        if starts is None:
+            starts = self._starts = tuple(r.start for r in self._ranges)
+        i = bisect.bisect_right(starts, token) - 1
         if i >= 0 and self._ranges[i].contains_token(token):
             return self._ranges[i]
         return None
