@@ -146,9 +146,17 @@ class Recover(Callback):
             self._invalidate(merged)
             return
         # the fast path may have been taken; earlier accepted txns that never
-        # witnessed us must commit before that is sound (Recover.java:322-336)
-        if not merged.earlier_no_witness.is_empty:
-            self._await_commits(merged.earlier_no_witness)
+        # witnessed us must commit before that is sound (Recover.java:322-336).
+        # Unresolved elision covers join the same await: a replica reported
+        # omission evidence it could not classify because the would-be cover
+        # write is not decided locally (CommandsForKey.omission_covers) —
+        # once the cover commits, the retried round reads the omission as
+        # either legal elision or genuine reject evidence.
+        blocking = merged.earlier_no_witness
+        if not merged.unresolved_covers.is_empty:
+            blocking = blocking.with_(merged.unresolved_covers)
+        if not blocking.is_empty:
+            self._await_commits(blocking)
             return
         self._propose(merged, self.txn_id.as_timestamp(),
                       merged.latest_deps.merge_proposal())

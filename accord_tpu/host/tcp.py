@@ -266,7 +266,14 @@ class TcpHost:
             self._client_submit(from_id, body)
             return
         if kind == "stop":
-            self.running = False
+            # accept stop only from harness/client frames (non-positive
+            # declared src).  NOTE: src is self-declared — this guards
+            # against misdirected frames from well-behaved nodes, not
+            # against a hostile peer (which could claim src 0).  This
+            # transport is a localhost bench harness; real deployments
+            # need authenticated connections before trusting any frame.
+            if from_id <= 0:
+                self.running = False
             return
         payload = decode_message(body["payload"])
         if "in_reply_to" in body:

@@ -157,10 +157,14 @@ def decode(data: Any) -> Any:
         if k == "$RK":
             return _RoutingKey(v)
         if k == "$Ks":
-            return _Keys([_Key(t) for t in v], _presorted=True)
+            # verify the remote peer's ordering before trusting it: an
+            # unsorted list silently corrupts bisect-based set operations
+            ok = all(v[i] < v[i + 1] for i in range(len(v) - 1))
+            return _Keys([_Key(t) for t in v], _presorted=ok)
         if k == "$RKs":
+            ok = all(v[i] < v[i + 1] for i in range(len(v) - 1))
             return _RoutingKeys([_RoutingKey(t) for t in v],
-                                _presorted=True)
+                                _presorted=ok)
     if "$t" in data:
         t = data["$t"]
         if all(type(x) is int for x in t):

@@ -146,10 +146,11 @@ class DeviceSafeCommandStore(SafeCommandStore):
                 raise err
         return {k: keyed[k] for k in keys if keyed.get(k)}
 
-    def _rejects_fast_path_keys(self, txn_id: TxnId, participants) -> bool:
-        # the batched masks enumerate RAW candidates; elision suppression
-        # (CommandsForKey._missing_explicable_by_elision) is a host-side
-        # post-filter shared with the scalar path
+    def _decipher_fast_path_keys(self, txn_id: TxnId, participants):
+        # the batched masks enumerate RAW candidates; the elision
+        # classifier (CommandsForKey.omission_covers) is a host-side
+        # post-step shared with the scalar path — including its third
+        # verdict (unresolved covers the coordinator must await)
         def scalar_collect(out):
             for cfk in self._participant_cfks(participants):
                 found = cfk.started_after_without_witnessing_ids(txn_id,
@@ -160,7 +161,7 @@ class DeviceSafeCommandStore(SafeCommandStore):
         served_a = self._serve_recovery("rejects_a", txn_id, participants,
                                         scalar_collect)
         if served_a is None:
-            return super()._rejects_fast_path_keys(txn_id, participants)
+            return super()._decipher_fast_path_keys(txn_id, participants)
 
         def scalar_collect_b(out):
             for cfk in self._participant_cfks(participants):
@@ -172,15 +173,8 @@ class DeviceSafeCommandStore(SafeCommandStore):
         served_b = self._serve_recovery("rejects_b", txn_id, participants,
                                         scalar_collect_b)
         if served_b is None:
-            return super()._rejects_fast_path_keys(txn_id, participants)
-        return self._any_unsuppressed(served_a, txn_id) \
-            or self._any_unsuppressed(served_b, txn_id)
-
-    def _any_unsuppressed(self, served: Dict, txn_id: TxnId) -> bool:
-        # one implementation of the filter: CommandsForKey._filter_elided
-        # (the same one the scalar predicates apply)
-        return any(self.cfk(key)._filter_elided(list(ids), txn_id)
-                   for key, ids in served.items())
+            return super()._decipher_fast_path_keys(txn_id, participants)
+        return self._classify_omission_maps((served_a, served_b), txn_id)
 
     def _earlier_committed_witness_keys(self, txn_id, participants,
                                         builder) -> None:

@@ -165,10 +165,10 @@ class CommandsForKey:
         self._missing: List[Tuple[TxnId, ...]] = []
         # the WRITE ids among each entry's registered deps at this key —
         # the entry's potential elision covers.  Resolved to timestamps at
-        # QUERY time (locally-known executeAt when committed, id as a
-        # lower bound otherwise) so a dep that commits after registration
-        # contributes its real executeAt (see
-        # _missing_explicable_by_elision)
+        # QUERY time (locally-known executeAt when committed; reported
+        # unresolved to the recovery coordinator otherwise) so a dep that
+        # commits after registration contributes its real executeAt (see
+        # omission_covers)
         self._wdeps: List[Tuple[TxnId, ...]] = []
         # (executeAt, txn_id) sorted, for entries COMMITTED..APPLIED
         self._committed: List[Tuple[Timestamp, TxnId]] = []
@@ -504,10 +504,9 @@ class CommandsForKey:
     # the four BeginRecovery predicates (BeginRecovery.java:329-380).
     # The *_ids variants return the matching ids (the batched device store
     # verifies its precomputed masks against them); the bool forms delegate.
-    def _missing_explicable_by_elision(self, i: int, txn_id: TxnId) -> bool:
-        """Entry i carries deps that omit `txn_id` — is that omission
-        explicable by TRANSITIVE ELISION rather than evidence that txn_id
-        was never witnessed?
+    def omission_covers(self, i: int, txn_id: TxnId,
+                        resolve=None) -> Optional[Tuple[TxnId, ...]]:
+        """Entry i carries deps that omit `txn_id` — classify the omission.
 
         The deps calc (map_reduce_active) elides any committed entry whose
         executeAt lies below the last-executing committed write, so a
@@ -520,32 +519,142 @@ class CommandsForKey:
         later committed write as the elision bound, and a recovery quorum
         that avoided every committed copy).  The reference ships the same
         elision with an unproven-correctness TODO
-        (CommandsForKey.java:640 PRUNE_TRANSITIVE_DEPENDENCIES); this
-        predicate-side guard is our correction: the omission is
-        inconclusive iff entry i's REGISTERED deps witness some write
-        executing after txn_id — under the hypothesis that write must
-        itself order after txn_id, so depending on it transitively covers
-        it.  The write-dep ids were recorded from the true dep list at
+        (CommandsForKey.java:640 PRUNE_TRANSITIVE_DEPENDENCIES).
+
+        Returns a three-way verdict mirroring the exact elision rule:
+
+        * ``None`` — ELIDED.  Some registered write dep of entry i is
+          COMMITTED with executeAt strictly between the hypothesised
+          fast-path timestamp and the entry's deps-known-before bound —
+          exactly the window in which map_reduce_active elides: the
+          bound write is always itself visited (only entries strictly
+          below the bound are pruned), so if txn_id was elided, its
+          cover IS among the entry's registered write deps.  The
+          omission is no evidence either way; suppress it.
+        * ``()`` — EVIDENCE.  Every registered write dep is resolved
+          (committed outside the window, or invalidated) and none could
+          have been a legal elision bound; the omission genuinely
+          refutes the fast path.
+        * non-empty tuple — INCONCLUSIVE.  The listed write deps are not
+          decided locally, and any of them may yet commit (possibly on
+          the slow path, with an executeAt well above its id) into the
+          covering window.  The caller must NOT read the omission as
+          evidence host-side: the recovery coordinator awaits these
+          covers' commits and retries, by which time they resolve into
+          one of the two definite verdicts.  This also closes the
+          residual soundness edge recorded in round 3's SOAK_NOTES: a
+          cover whose id is below the hypothesised timestamp but whose
+          slow-path executeAt (above it) is not locally known used to
+          be mis-read as reject evidence (its id was used as the
+          resolution), re-opening the seed-16005 hazard; now it is
+          reported unresolved and resolved by the coordinator.
+
+        LIVENESS (await acyclicity): only undecided covers with id
+        STRICTLY BELOW txn_id are reported unresolved.  Awaiting a cover
+        triggers recovery of the cover if its coordinator died, and that
+        recovery may itself await covers — were awaits unordered, two
+        undecided writes could await each other through crossing deps
+        (x deps=[b] omitting w, y deps=[w] omitting b: Recovery(w) parks
+        on b while Recovery(b) parks on w, both wedged forever, the
+        seed-15003 acked-write-loss class).  Restricting awaits to
+        strictly-smaller ids makes every await chain strictly
+        decreasing, hence finite and cycle-free.  An undecided cover
+        with id ABOVE txn_id instead suppresses the omission (its
+        eventual executeAt necessarily exceeds the hypothesis, so it
+        may legally have elided txn_id at a replica that saw it
+        committed): the fail-safe direction — reading the omission as
+        evidence risks invalidating a committed txn (seed 16005), the
+        strictly worse failure — and exactly round 3's behaviour for
+        this sub-case, soaked over ~226 hostile seeds.  When the cover
+        later resolves, a retried recovery reads the omission
+        definitively.
+
+        `resolve(w) -> ('committed', executeAt) | ('invalid', None) |
+        ('undecided', None) | None` lets the store consult its command
+        registry for deps this CFK no longer tracks precisely
+        (INVALID_OR_TRUNCATED conflates invalidated with
+        truncated-applied; prune_redundant drops entries wholesale).  A
+        cover that is untrackable even there — pruned below the
+        redundancy watermark AND erased from the registry — is treated
+        as a cover (suppress): erasure requires the shard's durable
+        frontier to have advanced past it, so it was applied at some
+        executeAt we can no longer observe; reading its omission as
+        reject evidence risks invalidating a committed txn (the
+        seed-16005 class, the strictly worse failure), while awaiting
+        it would livelock (it is already durably decided everywhere, so
+        a WaitOnCommit acks instantly and a retry learns nothing new).
+
+        The write-dep ids were recorded from the true dep list at
         registration (the missing[] encoding can't answer this because
         decided ids are exempt from it); each is resolved HERE so a dep
         that committed after registration contributes its real executeAt
         (its id alone is only a lower bound on where it executes)."""
         hyp = txn_id.as_timestamp()
+        bound = _deps_known_before(self._ids[i], self._status[i],
+                                   self._eat[i])
+        unresolved: List[TxnId] = []
         for t in self._wdeps[i]:
             if t == txn_id:
                 continue
             p = self._pos(t)
-            e = (self._eat_of(p) if p >= 0 and self._status[p].is_committed
-                 else t.as_timestamp())
-            if e > hyp:
-                return True
-        return False
+            if p >= 0 and self._status[p].is_committed:
+                e = self._eat_of(p)
+                if hyp < e < bound:
+                    return None  # definite elision cover
+                continue  # committed outside the window: no cover
+            if p >= 0 and self._status[p] != InternalStatus.INVALID_OR_TRUNCATED:
+                # witnessed here but undecided: consult the registry (it may
+                # know a commit this per-key view hasn't absorbed yet)
+                r = resolve(t) if resolve is not None else None
+                if r is None or r[0] == "undecided":
+                    if t > txn_id:
+                        return None  # suppress: see LIVENESS note above
+                    unresolved.append(t)
+                    continue
+            else:
+                # INVALID_OR_TRUNCATED in place, or pruned entirely: the
+                # per-key view can't distinguish invalidated (no cover)
+                # from truncated-applied (possible cover)
+                r = resolve(t) if resolve is not None else None
+                if r is None:
+                    return None  # untrackable: suppress (see docstring)
+            kind, eat = r
+            if kind == "committed":
+                if eat is not None and hyp < eat < bound:
+                    return None
+                continue
+            if kind == "invalid":
+                continue
+            if t > txn_id:
+                return None  # suppress: see LIVENESS note above
+            unresolved.append(t)
+        return tuple(unresolved)
+
+    def classify_omissions(self, found: List[TxnId], txn_id: TxnId,
+                           resolve=None
+                           ) -> Tuple[List[TxnId], List[TxnId]]:
+        """Partition raw omission candidates into (evidence, unresolved
+        cover ids).  An entry whose omission is elision-shaped contributes
+        to neither; an entry with undecided cover candidates contributes
+        those covers to `unresolved` instead of itself to `evidence`."""
+        evidence: List[TxnId] = []
+        unresolved: List[TxnId] = []
+        for t in found:
+            covers = self.omission_covers(self._pos(t), txn_id, resolve)
+            if covers is None:
+                continue
+            if covers:
+                unresolved.extend(covers)
+            else:
+                evidence.append(t)
+        return evidence, unresolved
 
     def _filter_elided(self, found: List[TxnId], txn_id: TxnId
                        ) -> List[TxnId]:
-        return [t for t in found
-                if not self._missing_explicable_by_elision(
-                    self._pos(t), txn_id)]
+        """Definite-evidence filter (no resolver): entries whose omission is
+        elided OR inconclusive are dropped.  Callers that can act on
+        inconclusiveness use classify_omissions instead."""
+        return self.classify_omissions(found, txn_id)[0]
 
     def started_after_without_witnessing_ids(self, txn_id: TxnId,
                                              raw: bool = False
@@ -569,7 +678,7 @@ class CommandsForKey:
                                               ) -> List[TxnId]:
         """hasStableExecutesAfterWithoutWitnessing (ANY started-at; the dep
         test already restricts to executeAt > txn_id).  Elision-shaped
-        omissions are inconclusive (see _missing_explicable_by_elision)."""
+        omissions are inconclusive (see omission_covers)."""
         found: List[TxnId] = []
         self.map_reduce_full(txn_id, txn_id.kind.witnessed_by(),
                              TestStartedAt.ANY, TestDep.WITHOUT,

@@ -14,7 +14,7 @@ via `execute`, with replies reduced across shards.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, TYPE_CHECKING
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple, TYPE_CHECKING
 
 from accord_tpu.local.cfk import CommandsForKey, InternalStatus, TimestampsForKey, Unmanaged
 from accord_tpu.local.command import Command
@@ -292,16 +292,81 @@ class SafeCommandStore:
     # range-command walk, always live).
 
     def rejects_fast_path(self, txn_id: TxnId, participants) -> bool:
-        return self._rejects_fast_path_keys(txn_id, participants) \
-            or self._rejects_fast_path_ranges(txn_id, participants)
+        return self.decipher_fast_path(txn_id, participants)[0]
 
-    def _rejects_fast_path_keys(self, txn_id: TxnId, participants) -> bool:
+    def decipher_fast_path(self, txn_id: TxnId, participants
+                           ) -> Tuple[bool, "Deps"]:
+        """(rejects, unresolved_covers): the fast-path reject predicates
+        with the elision classifier's third verdict surfaced.  `rejects`
+        is definite evidence; `unresolved_covers` are key-associated write
+        deps whose commit status must resolve before omission evidence at
+        this replica can be read either way (CommandsForKey.
+        omission_covers) — the recovery coordinator awaits their commit
+        and retries, exactly like earlier-accepted-no-witness deps
+        (Recover.java:322-336)."""
+        rejects, unresolved = self._decipher_fast_path_keys(txn_id,
+                                                            participants)
+        if not rejects and self._rejects_fast_path_ranges(txn_id,
+                                                          participants):
+            rejects = True
+        if rejects or not unresolved:
+            return rejects, Deps.NONE
+        from accord_tpu.primitives.deps import KeyDeps
+        builder = KeyDeps.builder()
+        for key, cover in unresolved:
+            builder.add(key, cover)
+        return False, Deps(builder.build(), None)
+
+    def _cover_resolver(self):
+        """Resolve a cover candidate against the store-wide command
+        registry (the per-key view conflates invalidated with
+        truncated-applied and drops pruned entries wholesale)."""
+        commands = self.store.commands
+
+        def resolve(w: TxnId):
+            cmd = commands.get(w)
+            if cmd is None:
+                return None  # never materialised here / erased: CFK decides
+            if cmd.is_invalidated:
+                return ("invalid", None)
+            if cmd.execute_at is not None \
+                    and cmd.has_been(SaveStatus.PRE_COMMITTED):
+                return ("committed", cmd.execute_at)
+            if cmd.is_truncated:
+                return None  # applied-and-shed: executeAt unobservable
+            return ("undecided", None)
+
+        return resolve
+
+    def _decipher_fast_path_keys(self, txn_id: TxnId, participants
+                                 ) -> Tuple[bool, List[Tuple[Key, TxnId]]]:
+        served_a: Dict[Key, List[TxnId]] = {}
+        served_b: Dict[Key, List[TxnId]] = {}
         for cfk in self._participant_cfks(participants):
-            if cfk.accepted_or_committed_started_after_without_witnessing(txn_id):
-                return True
-            if cfk.committed_executes_after_without_witnessing(txn_id):
-                return True
-        return False
+            raw = cfk.started_after_without_witnessing_ids(txn_id, raw=True)
+            if raw:
+                served_a[cfk.key] = raw
+            raw = cfk.executes_after_without_witnessing_ids(txn_id, raw=True)
+            if raw:
+                served_b[cfk.key] = raw
+        return self._classify_omission_maps((served_a, served_b), txn_id)
+
+    def _classify_omission_maps(self, served_maps, txn_id: TxnId
+                                ) -> Tuple[bool, List[Tuple[Key, TxnId]]]:
+        """The shared host-side classification step over {key: raw
+        candidate ids} maps — ONE implementation for the scalar and
+        device-served paths, so the soundness-critical evidence /
+        elided / unresolved triage cannot diverge between them."""
+        resolve = self._cover_resolver()
+        unresolved: List[Tuple[Key, TxnId]] = []
+        for mapping in served_maps:
+            for key, ids in mapping.items():
+                evidence, covers = self.cfk(key).classify_omissions(
+                    list(ids), txn_id, resolve)
+                if evidence:
+                    return True, []
+                unresolved.extend((key, w) for w in covers)
+        return False, unresolved
 
     def _rejects_fast_path_ranges(self, txn_id: TxnId, participants) -> bool:
         wb = lambda t: self._witnessed_by(t, txn_id)

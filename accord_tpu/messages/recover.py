@@ -31,7 +31,8 @@ class RecoverOk(Reply):
                  writes: Optional[Writes], result,
                  rejects_fast_path: bool,
                  earlier_committed_witness: Deps,
-                 earlier_no_witness: Deps):
+                 earlier_no_witness: Deps,
+                 unresolved_covers: Deps = Deps.NONE):
         self.txn_id = txn_id
         self.status = status
         self.accepted_ballot = accepted_ballot
@@ -46,6 +47,11 @@ class RecoverOk(Reply):
         self.rejects_fast_path = rejects_fast_path
         self.earlier_committed_witness = earlier_committed_witness
         self.earlier_no_witness = earlier_no_witness
+        # write deps whose undecided commit status makes this replica's
+        # omission evidence inconclusive (CommandsForKey.omission_covers):
+        # the coordinator must await their commit and retry before reading
+        # the fast-path decipher either way
+        self.unresolved_covers = unresolved_covers
 
     @property
     def witnessed_at_original(self) -> bool:
@@ -92,7 +98,8 @@ class RecoverOk(Reply):
             writes,
             hi.result if hi.result is not None else lo.result,
             self.rejects_fast_path or other.rejects_fast_path,
-            witness, no_witness)
+            witness, no_witness,
+            self.unresolved_covers.with_(other.unresolved_covers))
 
     def __repr__(self):
         return (f"RecoverOk({self.txn_id!r}, {self.status.name}, "
@@ -137,6 +144,7 @@ class BeginRecovery(TxnRequest):
         rejects = False
         earlier_witness = Deps.NONE
         earlier_no_witness = Deps.NONE
+        unresolved_covers = Deps.NONE
         known_deps = cmd.known().deps
         if known_deps < KnownDeps.COMMITTED:
             # no committed/decided deps held here: contribute a fresh local
@@ -147,7 +155,8 @@ class BeginRecovery(TxnRequest):
                                           before=self.txn_id)
         if not cmd.has_been(SaveStatus.PRE_COMMITTED):
             # fast-path decipher predicates only matter pre-decision
-            rejects = safe_store.rejects_fast_path(self.txn_id, keys)
+            rejects, unresolved_covers = safe_store.decipher_fast_path(
+                self.txn_id, keys)
             earlier_witness = safe_store.earlier_committed_witness(
                 self.txn_id, keys)
             earlier_no_witness = safe_store.earlier_accepted_no_witness(
@@ -162,7 +171,7 @@ class BeginRecovery(TxnRequest):
         return RecoverOk(
             self.txn_id, cmd.save_status, cmd.accepted_ballot, cmd.execute_at,
             latest, cmd.partial_txn, cmd.writes, cmd.result,
-            rejects, earlier_witness, earlier_no_witness)
+            rejects, earlier_witness, earlier_no_witness, unresolved_covers)
 
     def _local_keys(self, safe_store, cmd):
         """Participants (Keys or Ranges) for deps calc + decipher predicates."""

@@ -298,29 +298,86 @@ class TestElisionAwareRecoveryPredicates:
         # the raw (device-mask) enumeration still lists the candidate
         assert cfk.started_after_without_witnessing_ids(w, raw=True) == [x]
 
-    def test_omission_with_uncommitted_bound_still_suppressed(self):
-        # the cover's LOCAL status is irrelevant: it may be committed at
-        # another replica, where it legally elided w.  Its id alone (above
-        # w) lower-bounds where it executes.
+    def test_omission_with_uncommitted_bound_above_suppresses(self):
+        # the cover's id (200) is ABOVE the hypothesis (100): its eventual
+        # executeAt necessarily exceeds the hypothesis, so it may have
+        # legally elided w at a replica that saw it committed.  Awaiting
+        # it is forbidden (covers above the txn under recovery would let
+        # two recoveries await each other through crossing deps — the
+        # LIVENESS note in omission_covers), so the omission suppresses:
+        # the fail-safe direction, exactly round 3's behaviour here.
         cfk, w, b, x = self._world(InternalStatus.ACCEPTED)
         assert cfk.started_after_without_witnessing_ids(w) == []
+        raw = cfk.started_after_without_witnessing_ids(w, raw=True)
+        assert cfk.classify_omissions(raw, w) == ([], [])
 
-    def test_cover_committing_after_registration_suppresses(self):
+    def test_cover_committing_after_registration_resolves(self):
         # b (id BELOW w) slow-path commits to an executeAt above w only
         # AFTER x registered its deps: the cover must be resolved at query
-        # time, not frozen at registration (review r3 finding)
+        # time, not frozen at registration (review r3 finding).  Until b
+        # commits its position is UNKNOWABLE — its id (50 < w) is only a
+        # lower bound on where it executes — so the omission must be
+        # reported unresolved, not read as evidence (the r3 SOAK_NOTES
+        # residual edge: treating it as evidence re-opens seed 16005).
         cfk = CommandsForKey(Key(1))
         b, w, x = wid(50), wid(100), wid(300)
         cfk.update(b, InternalStatus.PREACCEPTED)
         cfk.update(w, InternalStatus.PREACCEPTED)
         cfk.update(x, InternalStatus.ACCEPTED, execute_at=ts(300),
                    dep_ids=[b])
-        # pre-commit: b's only known bound is its id (50 < 100) — evidence
-        assert cfk.started_after_without_witnessing_ids(w) == [x]
+        raw = cfk.started_after_without_witnessing_ids(w, raw=True)
+        evidence, unresolved = cfk.classify_omissions(raw, w)
+        assert evidence == [] and unresolved == [b]
         cfk.update(b, InternalStatus.COMMITTED, execute_at=ts(150),
                    dep_ids=[])
-        # b now executes at 150 > w: the omission is elision-explicable
+        # b now executes at 150, inside (w, x): the omission is
+        # elision-explicable — neither evidence nor unresolved
+        assert cfk.classify_omissions(raw, w) == ([], [])
         assert cfk.started_after_without_witnessing_ids(w) == []
+
+    def test_cover_committing_below_hypothesis_restores_evidence(self):
+        # the unresolved cover commits at an executeAt BELOW w: it was
+        # never a legal elision bound, so the omission hardens into
+        # full-strength reject evidence on the retried recovery round
+        cfk = CommandsForKey(Key(1))
+        b, w, x = wid(50), wid(100), wid(300)
+        cfk.update(b, InternalStatus.PREACCEPTED)
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=ts(300),
+                   dep_ids=[b])
+        cfk.update(b, InternalStatus.COMMITTED, execute_at=ts(60),
+                   dep_ids=[])
+        assert cfk.started_after_without_witnessing_ids(w) == [x]
+
+    def test_cover_above_entry_bound_is_no_cover(self):
+        # r3 advisor finding (high): a cover whose executeAt exceeds the
+        # entry's own deps-known-before bound could never have been the
+        # elision bound for that entry's calculation — the omission stays
+        # evidence.  (The old predicate accepted ANY write dep resolving
+        # above the hypothesis, erasing reject evidence under write
+        # contention.)
+        cfk = CommandsForKey(Key(1))
+        w, c, x = wid(100), wid(200), wid(300)
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(c, InternalStatus.COMMITTED, execute_at=ts(400),
+                   dep_ids=[w])
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=ts(300),
+                   dep_ids=[c])
+        # c commits OUTSIDE (w, x): x's omission of w is genuine evidence
+        assert cfk.started_after_without_witnessing_ids(w) == [x]
+
+    def test_invalidated_cover_is_no_cover(self):
+        # a never-committed/invalidated dep provides no transitive cover
+        # (r3 advisor finding): the omission stays evidence
+        cfk = CommandsForKey(Key(1))
+        w, c, x = wid(100), wid(200), wid(300)
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(c, InternalStatus.ACCEPTED, execute_at=ts(250))
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=ts(300),
+                   dep_ids=[c])
+        resolve = lambda t: ("invalid", None) if t == c else None
+        raw = cfk.started_after_without_witnessing_ids(w, raw=True)
+        assert cfk.classify_omissions(raw, w, resolve) == ([x], [])
 
     def test_omission_with_only_earlier_write_deps_is_evidence(self):
         # x's only write dep STARTS (and so executes) before w: no elision

@@ -388,3 +388,74 @@ class TestDurabilityAndTruncation:
         from accord_tpu.utils.invariants import InvariantError
         with pytest.raises(InvariantError):
             C.purge(safe, txn_id)
+
+
+class TestDecipherFastPath:
+    """Store-level fast-path decipher with the three-way elision classifier
+    (CommandsForKey.omission_covers + the command-registry resolver):
+    definite reject evidence, elision suppression, and unresolved covers
+    the recovery coordinator must await (r3 advisor finding + the r3
+    SOAK_NOTES residual edge)."""
+
+    def _ids(self, node, *hlcs):
+        return [TxnId.create(node.epoch, h, TxnKind.WRITE, Domain.KEY,
+                             node.id) for h in hlcs]
+
+    def test_unresolved_cover_reported_then_resolved(self, env):
+        from accord_tpu.local.cfk import InternalStatus
+        node, store, safe = env
+        key = Key(10)
+        b, w, x = self._ids(node, 50, 100, 300)
+        cfk = safe.cfk(key)
+        cfk.update(b, InternalStatus.PREACCEPTED)
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=Timestamp(
+            node.epoch, 300, 0, node.id), dep_ids=[b])
+        participants = Keys.of(10)
+
+        rejects, unresolved = safe.decipher_fast_path(w, participants)
+        assert not rejects
+        assert unresolved.sorted_txn_ids() == [b]
+        assert not safe.rejects_fast_path(w, participants)
+
+        # cover commits INSIDE the elision window: suppressed entirely
+        cfk.update(b, InternalStatus.COMMITTED, execute_at=Timestamp(
+            node.epoch, 150, 0, node.id), dep_ids=[])
+        rejects, unresolved = safe.decipher_fast_path(w, participants)
+        assert not rejects and unresolved.is_empty
+
+    def test_cover_resolved_from_command_registry(self, env):
+        """The per-key view lags: the cover is undecided in the CFK but the
+        command registry already holds its commit — the resolver must use
+        the registry's executeAt instead of reporting unresolved."""
+        from accord_tpu.local.cfk import InternalStatus
+        node, store, safe = env
+        key = Key(10)
+        b, w, x = self._ids(node, 50, 100, 300)
+        cfk = safe.cfk(key)
+        cfk.update(b, InternalStatus.PREACCEPTED)
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=Timestamp(
+            node.epoch, 300, 0, node.id), dep_ids=[b])
+        cmd = store.commands.setdefault(b, Command(b))
+        cmd.save_status = SaveStatus.COMMITTED
+        cmd.execute_at = Timestamp(node.epoch, 150, 0, node.id)
+        rejects, unresolved = safe.decipher_fast_path(w, Keys.of(10))
+        assert not rejects and unresolved.is_empty
+
+    def test_invalidated_cover_restores_evidence(self, env):
+        """A cover the registry knows is INVALIDATED was never a legal
+        elision bound: the omission hardens into definite evidence."""
+        from accord_tpu.local.cfk import InternalStatus
+        node, store, safe = env
+        key = Key(10)
+        b, w, x = self._ids(node, 50, 100, 300)
+        cfk = safe.cfk(key)
+        cfk.update(b, InternalStatus.PREACCEPTED)
+        cfk.update(w, InternalStatus.PREACCEPTED)
+        cfk.update(x, InternalStatus.ACCEPTED, execute_at=Timestamp(
+            node.epoch, 300, 0, node.id), dep_ids=[b])
+        cmd = store.commands.setdefault(b, Command(b))
+        cmd.save_status = SaveStatus.INVALIDATED
+        rejects, unresolved = safe.decipher_fast_path(w, Keys.of(10))
+        assert rejects and unresolved.is_empty

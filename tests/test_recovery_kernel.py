@@ -24,9 +24,9 @@ def scalar_predicates(cfks, probe, keys):
     no_witness = set()
     for k in keys:
         cfk = by_key[k]
-        # the kernel's contract is the RAW candidate enumeration; elision
-        # suppression is a host-side post-filter shared by both paths
-        # (device_store._any_unsuppressed)
+        # the kernel's contract is the RAW candidate enumeration; the
+        # elision classifier is a host-side post-step shared by both paths
+        # (CommandsForKey.classify_omissions / omission_covers)
         rejects_a |= bool(
             cfk.started_after_without_witnessing_ids(probe, raw=True))
         rejects_b |= bool(
