@@ -32,12 +32,45 @@ Extra BASELINE configs (not part of the driver's one-line contract):
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
 
 
 PLATFORM = "unprobed"  # set by main() for device-using configs
+JSON_OUT = None        # optional path: emit() mirrors the JSON line there
+ROWS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_DEVICE_ROWS.json")
+
+
+def emit(result: dict) -> None:
+    """Print the one-line JSON contract; mirror to --json-out if set (the
+    --fill orchestrator reads it back from the subprocess)."""
+    line = json.dumps(result)
+    print(line)
+    if JSON_OUT:
+        with open(JSON_OUT, "w") as f:
+            f.write(line + "\n")
+
+
+def _load_rows() -> dict:
+    try:
+        with open(ROWS_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _store_row(config: str, result: dict) -> None:
+    """Checkpoint a completed config's result the moment it finishes —
+    tunnel flaps must never cost an already-captured row."""
+    rows = _load_rows()
+    rows[config] = result
+    tmp = ROWS_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rows, f, indent=1, sort_keys=True)
+    os.replace(tmp, ROWS_PATH)
 
 
 def build_world(n_keys=1024, n_existing=65536, n_batch=512, seed=42,
@@ -192,13 +225,24 @@ def bench_default():
     assert scalar_edges == edges, (
         f"device/scalar edge mismatch: {edges} vs {scalar_edges}")
 
-    print(json.dumps({
+    result = {
         "metric": "conflict_graph_edges_resolved_per_sec",
         "value": round(device_eps, 1),
         "unit": "edges/s",
         "vs_baseline": round(device_eps / scalar_eps, 2),
         "platform": PLATFORM,
-    }))
+    }
+    if PLATFORM.startswith("cpu"):
+        # tunnel dead at capture time: point at the checkpointed on-chip
+        # capture (BENCH_DEVICE_ROWS.json, written by --fill during a live
+        # window) so the artifact still carries the chip evidence
+        row = _load_rows().get("default")
+        if row and row.get("platform", "").startswith("axon"):
+            result["last_onchip"] = {
+                "value": row["value"], "vs_baseline": row.get("vs_baseline"),
+                "platform": row["platform"],
+                "captured_unix": row.get("captured_unix")}
+    emit(result)
 
 
 # --------------------------------------------------------------- zipf1m ----
@@ -451,7 +495,7 @@ def bench_zipf1m(verify=False):
         assert total == edges, \
             f"stacked scan total {edges} != per-window total {total}"
     txns = world["n_batch"]
-    print(json.dumps({
+    emit(dict({
         "metric": "zipf1m_edges_resolved_per_sec",
         "value": round(edges / dt, 1),
         "unit": "edges/s",
@@ -550,7 +594,7 @@ def bench_rangestress(n_ranges=1_000_000, n_txns=10_000, seed=42,
     assert (y3 == y3[0]).all() and int(y1[0]) == edges == int(y3[0])
     dt = max((t3 - t1) / 2, 1e-9)
 
-    print(json.dumps({
+    emit(dict({
         "metric": "rangestress_edges_resolved_per_sec",
         "value": round(edges / dt, 1),
         "unit": "edges/s",
@@ -586,7 +630,7 @@ def bench_maelstrom(nodes=3, keys=100, n_ops=400, single_key=True,
     assert checked > 0.9 * n_ops, (checked, stats)
     assert stats["acked"] > 0.9 * n_ops, stats
     shape = "lin-kv single-key" if single_key else "txn-rw multi-key RMW"
-    print(json.dumps({
+    emit(dict({
         "metric": "maelstrom_host_txn_per_sec",
         "value": round(stats["acked"] / dt, 1),  # only verified-acked txns
         "unit": "txn/s",
@@ -685,7 +729,7 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16):
     finally:
         c.close()
     assert acked > 0.9 * n_ops, (acked, completed)
-    print(json.dumps({
+    emit(dict({
         "metric": "tcp_host_txn_per_sec",
         "value": round(acked / dt, 1),
         "unit": "txn/s",
@@ -917,7 +961,7 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
     cross = sum(int(h[0][0]) for h in h1)
     inwin = sum(int(h[0][1]) for h in h1)
     max_wave = max(int(h[0][2]) for h in h1)
-    print(json.dumps({
+    emit(dict({
         "metric": "tpcc_neworder_resolve_ms",
         "value": round(dt * 1e3, 2),
         "unit": "ms",
@@ -937,8 +981,96 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
     }))
 
 
+# ----------------------------------------------------------------- fill ----
+
+# device configs cheapest-first with generous per-config subprocess
+# timeouts: any short live-tunnel window fills the cheap rows before the
+# expensive ones get a chance to be interrupted
+FILL_CONFIGS = (("default", 600), ("rangestress", 900),
+                ("zipf1m", 1800), ("tpcc", 2400))
+
+
+def fill_device_rows(max_wait_s: float, only=None) -> int:
+    """Tunnel-flap-resilient capture of the on-chip device rows.
+
+    Each config runs in a SUBPROCESS with a hard timeout, so a tunnel that
+    dies mid-run (the round-3 failure mode: hangs, not errors) is killed
+    and retried instead of wedging the filler.  A completed on-chip row is
+    checkpointed to BENCH_DEVICE_ROWS.json the moment it lands.  Between
+    attempts the backend is re-probed (subprocess, bounded) and the filler
+    backs off while the tunnel is dead.  Returns the number of configs
+    still missing on exit."""
+    import subprocess
+    import sys
+    import tempfile
+
+    from accord_tpu.utils.backend import resolve_platform
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    pending = [(c, t) for c, t in FILL_CONFIGS
+               if only is None or c in only]
+    rows = _load_rows()
+    pending = [(c, t) for c, t in pending
+               if not rows.get(c, {}).get("platform", "").startswith("axon")]
+    deadline = time.time() + max_wait_s
+    backoff = 60.0
+    while pending and time.time() < deadline:
+        platform = resolve_platform()
+        if platform.startswith("cpu"):
+            wait = min(backoff, max(0.0, deadline - time.time()))
+            print(f"# tunnel dead ({platform}); {len(pending)} rows "
+                  f"pending; backing off {wait:.0f}s", flush=True)
+            if wait <= 0:
+                break
+            time.sleep(wait)
+            backoff = min(backoff * 2, 600.0)
+            continue
+        backoff = 60.0
+        cfg, tmo = pending[0]
+        out_path = tempfile.mktemp(prefix=f"bench_{cfg}_", suffix=".json")
+        print(f"# tunnel live ({platform}); running {cfg} "
+              f"(timeout {tmo}s)", flush=True)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "bench.py"),
+                 "--config", cfg, "--json-out", out_path],
+                timeout=tmo, capture_output=True, text=True, cwd=here)
+        except subprocess.TimeoutExpired:
+            print(f"# {cfg} timed out after {tmo}s (tunnel flap?); "
+                  f"will retry", flush=True)
+            continue
+        result = None
+        try:
+            with open(out_path) as f:
+                result = json.loads(f.read())
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+        if proc.returncode != 0 or result is None:
+            tail = (proc.stderr or "")[-500:]
+            print(f"# {cfg} failed (rc={proc.returncode}): {tail}",
+                  flush=True)
+            time.sleep(30)
+            continue
+        result["captured_unix"] = int(time.time())
+        _store_row(cfg, result)
+        plat = result.get("platform", "?")
+        print(f"# {cfg} captured on platform={plat}: "
+              f"{result.get('value')} {result.get('unit')}", flush=True)
+        if plat.startswith("cpu"):
+            # ran, but on the CPU fallback (tunnel died between probe and
+            # run): keep it pending for a live window
+            continue
+        pending.pop(0)
+    return len(pending)
+
+
 def main():
-    global PLATFORM
+    global PLATFORM, JSON_OUT
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="default",
                     choices=["default", "zipf1m", "rangestress", "tpcc",
@@ -946,7 +1078,23 @@ def main():
     ap.add_argument("--verify", action="store_true",
                     help="cross-check device window counts against a host "
                          "re-derivation (zipf1m)")
+    ap.add_argument("--json-out", default=None,
+                    help="also write the JSON line to this path")
+    ap.add_argument("--fill", action="store_true",
+                    help="resiliently capture all on-chip device rows to "
+                         "BENCH_DEVICE_ROWS.json (retries across tunnel "
+                         "flaps)")
+    ap.add_argument("--max-wait", type=float, default=3600.0,
+                    help="--fill: give up after this many seconds")
+    ap.add_argument("--only", default=None,
+                    help="--fill: comma-separated subset of configs")
     ns = ap.parse_args()
+    JSON_OUT = ns.json_out
+    if ns.fill:
+        only = set(ns.only.split(",")) if ns.only else None
+        missing = fill_device_rows(ns.max_wait, only)
+        print(f"# fill done; {missing} configs still missing")
+        raise SystemExit(0 if missing == 0 else 1)
     if ns.config not in ("maelstrom", "maelstrom-rw", "tcp"):
         # device-using configs probe the (possibly dead-tunneled) backend
         # first; host-only configs never touch the chip
