@@ -23,8 +23,15 @@ mechanisms enforce it:
     inline and asserted identical; the burn equivalence tests run with this
     on, so a whole hostile-cluster run certifies bit-identity at every query.
 
-Range-domain conflicts (RangeDeps tier) always run on the live scalar scan;
-the device tier covers the per-key CommandsForKey scans where the volume is.
+The range-command arm (RangeDeps tier) is device-served too: each window
+stabs the live range-command index with every declared probe in one [Q, N]
+kernel call (ops/range_kernel.py), version-gated on CommandStore.
+range_version — any register/cleanup mutation since the snapshot falls back
+to the scalar walk — with the activity filter and overlap computation
+re-run live over the kernel-pruned candidates.  And execution ordering is
+device-planned: windows holding several Applies are scheduled by the
+wavefront kernel (ops/wavefront.py) in Kahn-layer order, with the scalar
+WaitingOn machinery still gating every transition (see _plan_waves).
 """
 
 from __future__ import annotations
@@ -55,6 +62,28 @@ class _Probe:
         self.key_set = key_set
         self.versions = versions
         self.committed_versions = committed_versions
+
+
+class _RangeProbe:
+    """One precomputed range-command stab (ops/range_kernel.py): the
+    kernel-pruned candidate set of range txns geometrically intersecting
+    the probe's participants.  Serving re-runs ONLY the scalar activity
+    filter and overlap computation over the candidates (cost proportional
+    to matches, not to the live range-command population).  Version-gated
+    on CommandStore.range_version (any register/cleanup mutation since the
+    snapshot falls back to the scalar walk)."""
+
+    __slots__ = ("before", "kinds", "mode", "owned_repr", "candidates",
+                 "version")
+
+    def __init__(self, before: Timestamp, kinds: KindSet, mode: str,
+                 owned_repr, candidates: Tuple[TxnId, ...], version: int):
+        self.before = before
+        self.kinds = kinds
+        self.mode = mode            # "keys" | "ranges"
+        self.owned_repr = owned_repr
+        self.candidates = candidates
+        self.version = version
 
 
 class _RecoveryProbe:
@@ -103,6 +132,84 @@ class DeviceSafeCommandStore(SafeCommandStore):
                     fn(key, dep)
         self._map_range_conflicts(owned, False, before, kinds, fn,
                                   on_range_dep)
+
+    # ------------------------------------------------- range-conflict arm --
+    def _map_range_conflicts(self, owned, is_range: bool, before: Timestamp,
+                             kinds: KindSet, fn, on_range_dep) -> None:
+        """Serve the range-command arm from the window's batched stab
+        (ops/range_kernel.py) when a version-valid probe covers the query;
+        the activity filter and overlap computation re-run live over the
+        kernel-pruned candidates only."""
+        store: DeviceCommandStore = self.store
+        if not store.range_commands:
+            return  # scalar walk over an empty index is a no-op
+        probe = store._precomputed_ranges.get((before, kinds))
+        ok = probe is not None and probe.version == store.range_version
+        if ok:
+            if is_range:
+                ok = probe.mode == "ranges" and probe.owned_repr == tuple(
+                    (r.start, r.end) for r in owned)
+            else:
+                ok = probe.mode == "keys" and all(
+                    k.token in probe.owned_repr for k in owned)
+        if not ok:
+            store.device_range_misses += 1
+            return super()._map_range_conflicts(owned, is_range, before,
+                                                kinds, fn, on_range_dep)
+        store.device_range_hits += 1
+        served = []
+        for txn_id in probe.candidates:
+            if not self._active_range_conflict(txn_id, before, kinds):
+                continue
+            ranges = store.range_commands.get(txn_id)
+            if ranges is None:
+                continue  # unreachable under the version gate
+            if is_range:
+                overlap = ranges.intersection(owned)
+            else:
+                overlap = Ranges([r for r in ranges
+                                  if any(r.contains(k) for k in owned)])
+            if overlap.is_empty:
+                continue
+            if on_range_dep is not None:
+                served.append(("r", overlap, txn_id))
+            else:
+                for key in (self._owned_cfk_keys(overlap) if is_range
+                            else [k for k in owned if overlap.contains(k)]):
+                    served.append(("k", key, txn_id))
+        if store.verify:
+            self._verify_range_arm(owned, is_range, before, kinds,
+                                   on_range_dep is not None, served)
+        for tag, a, txn_id in served:
+            if tag == "r":
+                on_range_dep(a, txn_id)
+            else:
+                fn(a, txn_id)
+
+    def _verify_range_arm(self, owned, is_range, before, kinds,
+                          has_range_sink, served) -> None:
+        want = []
+        super()._map_range_conflicts(
+            owned, is_range, before, kinds,
+            lambda k, t: want.append(("k", k, t)),
+            (lambda o, t: want.append(("r", o, t)))
+            if has_range_sink else None)
+
+        def norm(items):
+            return sorted(
+                (tag, tuple((r.start, r.end) for r in a) if tag == "r"
+                 else a.token, t) for tag, a, t in items)
+
+        if norm(served) != norm(want):
+            err = AssertionError(
+                f"device range arm diverges from scalar at "
+                f"(before={before!r}): device={norm(served)} "
+                f"scalar={norm(want)}")
+            try:
+                self.store.agent.on_uncaught_exception(err)
+            except Exception:
+                pass
+            raise err
 
     # ---------------------------------------------- recovery scans (keys) --
     def _recovery_servable(self, txn_id: TxnId, participants):
@@ -236,7 +343,8 @@ class DeviceSafeCommandStore(SafeCommandStore):
             if t != exclude:
                 got.setdefault(k, []).append(t)
 
-        # key tier only — the range tier runs live on both paths
+        # key tier only — the range arm has its own probe machinery and
+        # verify pass (_map_range_conflicts / _verify_range_arm)
         for key in owned:
             cfk = self.store.cfks.get(key)
             if cfk is not None:
@@ -276,6 +384,11 @@ class DeviceCommandStore(CommandStore):
         self._flush_scheduled = False
         self._precomputed: Dict[Tuple[Timestamp, KindSet], _Probe] = {}
         self._precomputed_recovery: Dict[TxnId, _RecoveryProbe] = {}
+        self._precomputed_ranges: Dict[Tuple[Timestamp, KindSet],
+                                       _RangeProbe] = {}
+        # (range_version, ids, intervals, dev_starts, dev_ends) — the
+        # encoded range index, reused across windows until a mutation
+        self._range_index_cache = None
         self.device_hits = 0
         self.device_misses = 0
         self.device_batches = 0
@@ -283,6 +396,14 @@ class DeviceCommandStore(CommandStore):
         self.device_max_batch = 0
         self.device_recovery_hits = 0
         self.device_recovery_misses = 0
+        self.device_wave_batches = 0    # windows with a wave plan
+        self.device_wave_planned = 0    # applies scheduled by the kernel
+        self.device_wave_executed = 0   # planned applies that ran in-window
+        self.device_wave_max_depth = 0
+        self.device_range_hits = 0      # range arms served from the stab
+        self.device_range_misses = 0    # (counted only when work existed)
+        self.device_range_batches = 0
+        self.device_range_candidates = 0
         # set when the device backend dies mid-run (e.g. the TPU tunnel
         # drops): the store keeps serving every scan through the scalar
         # path instead of crashing the node
@@ -317,10 +438,13 @@ class DeviceCommandStore(CommandStore):
         window, self._window = self._window, []
         if not window:
             return
+        plan = None
         if not self.device_disabled:
             try:
                 self._precompute(window)
                 self._precompute_recovery(window)
+                self._precompute_ranges(window)
+                plan = self._plan_waves(window)
             except Exception as exc:  # noqa: BLE001 — mid-run backend death
                 if self.verify:
                     # equivalence-certification mode must not silently
@@ -334,21 +458,27 @@ class DeviceCommandStore(CommandStore):
                 self.device_disabled = True
                 self._precomputed = {}
                 self._precomputed_recovery = {}
+                self._precomputed_ranges = {}
                 self.agent.on_handled_exception(exc)
+        if plan is not None:
+            window = self._schedule_window(window, plan)
         try:
             for context, fn, result in window:
                 super()._submit(context, fn, result)
         finally:
             self._precomputed = {}
             self._precomputed_recovery = {}
+            self._precomputed_ranges = {}
+            if plan is not None:
+                self._account_wave_execution(plan)
 
     def _precompute(self, window) -> None:
         probes: List[Tuple[Timestamp, KindSet, List[Key]]] = []
         seen: Set[Tuple[Timestamp, KindSet]] = set()
         for context, _fn, _result in window:
             for before, kinds, keys in context.deps_probes:
-                if (before, kinds) in seen:
-                    continue
+                if (before, kinds) in seen or isinstance(keys, Ranges):
+                    continue  # range-domain probes go to the stab tier
                 owned = keys.slice(self.ranges) if not self.ranges.is_empty \
                     else keys
                 if len(owned) == 0:
@@ -421,3 +551,229 @@ class DeviceCommandStore(CommandStore):
                 txn_id, enc.decode_keyed(ra[i]), enc.decode_keyed(rb[i]),
                 enc.decode_keyed(cw[i]), enc.decode_keyed(anw[i]),
                 set(ks), versions)
+
+    def _precompute_ranges(self, window) -> None:
+        """Stab the live range-command index with every declared probe's
+        participants in one [Q, N] kernel call (ops/range_kernel.py; the
+        reference's per-query CINTIA checkpoint walk, RangeDeps.java:63-120
+        + SearchableRangeList.java:79, redesigned as a dense broadcast
+        compare).  Key-domain participants stab as unit intervals
+        [token, token+1); range-domain as their spans."""
+        self._precomputed_ranges = {}
+        if not self.range_commands:
+            return
+        probes = []
+        seen: Set[Tuple[Timestamp, KindSet]] = set()
+        for context, _fn, _result in window:
+            for before, kinds, participants in context.deps_probes:
+                if (before, kinds) in seen:
+                    continue
+                owned = participants.slice(self.ranges) \
+                    if not self.ranges.is_empty else participants
+                if isinstance(owned, Ranges):
+                    if owned.is_empty:
+                        continue
+                    spans = [(r.start, r.end) for r in owned]
+                    mode, owned_repr = "ranges", tuple(spans)
+                else:
+                    if len(owned) == 0:
+                        continue
+                    spans = [(k.token, k.token + 1) for k in owned]
+                    mode, owned_repr = "keys", frozenset(
+                        k.token for k in owned)
+                seen.add((before, kinds))
+                probes.append((before, kinds, mode, owned_repr, spans))
+        if not probes:
+            return
+
+        import jax.numpy as jnp
+
+        from accord_tpu.ops.encode import _pad_to
+        from accord_tpu.ops.range_kernel import range_stab_mask
+
+        # the encoded interval index is cached on range_version: a steady
+        # workload over a rarely-mutated index re-uses the device-resident
+        # bound arrays and pays only for the query side
+        cache = self._range_index_cache
+        if cache is not None and cache[0] == self.range_version:
+            _, ids, intervals, dev_starts, dev_ends = cache
+        else:
+            ids = list(self.range_commands.keys())
+            intervals = []
+            for idx, t in enumerate(ids):
+                for r in self.range_commands[t]:
+                    intervals.append((r.start, r.end, idx))
+            if not intervals:
+                self._range_index_cache = None
+                return
+            n_pad = _pad_to(len(intervals), 128)
+            starts = np.zeros(n_pad, np.int32)
+            ends = np.zeros(n_pad, np.int32)  # empty [0,0) pads never match
+            for i, (s, e, _idx) in enumerate(intervals):
+                starts[i], ends[i] = s, e
+            dev_starts = jnp.asarray(starts)
+            dev_ends = jnp.asarray(ends)
+            self._range_index_cache = (self.range_version, ids, intervals,
+                                       dev_starts, dev_ends)
+        if not intervals:
+            return
+        all_spans = [sp for _, _, _, _, spans in probes for sp in spans]
+        q_pad = _pad_to(len(all_spans), 128)
+        qs = np.zeros(q_pad, np.int32)
+        qe = np.zeros(q_pad, np.int32)
+        for i, (s, e) in enumerate(all_spans):
+            qs[i], qe[i] = s, e
+        mask = np.asarray(range_stab_mask(
+            dev_starts, dev_ends, jnp.asarray(qs), jnp.asarray(qe)))
+        self.device_range_batches += 1
+        version = self.range_version
+        row = 0
+        for before, kinds, mode, owned_repr, spans in probes:
+            cand: Set[TxnId] = set()
+            for _ in spans:
+                for j in np.nonzero(mask[row][:len(intervals)])[0]:
+                    cand.add(ids[intervals[j][2]])
+                row += 1
+            self.device_range_candidates += len(cand)
+            self._precomputed_ranges[(before, kinds)] = _RangeProbe(
+                before, kinds, mode, owned_repr, tuple(sorted(cand)),
+                version)
+
+    # ------------------------------------------------ wavefront scheduling --
+    def _plan_waves(self, window):
+        """Plan the window's Apply order with the wavefront kernel.
+
+        The scalar path resolves execution order one command at a time:
+        each applied dependency walks its listeners and re-tests WaitingOn
+        (reference Commands.maybeExecute :656 / NotifyWaitingOn :1011).
+        When several Applies land in one flush window, the device instead
+        computes the window's conflict DAG (ops.deps_kernel.in_batch_graph:
+        shared-key ∧ earlier-executeAt ∧ witnesses, one MXU matmul) and
+        Kahn-layers it (ops.wavefront.execution_waves); the window's
+        Applies then run in wave order, so each one finds its in-window
+        dependencies already applied and executes immediately instead of
+        parking in WaitingOn and being re-driven by the listener cascade.
+
+        Correctness NEVER depends on the plan: it only reorders message
+        application (legal under the protocol's arbitrary-delivery model —
+        the sim's nemeses reorder far more aggressively), and the scalar
+        WaitingOn machinery still gates every transition.  The plan's
+        *accuracy* is certified in verify mode: the device wave assignment
+        is asserted equal to the host oracle (ops.wavefront.waves_oracle)
+        on an identically-defined host-derived graph.
+
+        Returns {txn_id: (wave, execute_at)} or None when the window holds
+        fewer than two plannable Applies."""
+        from accord_tpu.local.status import SaveStatus
+
+        probes = []
+        seen: Set[TxnId] = set()
+        for context, _fn, _result in window:
+            for txn_id, execute_at, keys in context.execute_probes:
+                if txn_id in seen:
+                    continue
+                seen.add(txn_id)
+                cmd = self.commands.get(txn_id)
+                if cmd is not None and cmd.save_status >= SaveStatus.APPLYING:
+                    continue  # redundant re-delivery: nothing to schedule
+                owned = keys.slice(self.ranges) \
+                    if not self.ranges.is_empty else keys
+                if len(owned) == 0:
+                    continue
+                probes.append((txn_id, execute_at,
+                               [k.token for k in owned]))
+        if len(probes) < 2:
+            return None
+
+        import jax.numpy as jnp
+
+        from accord_tpu.ops.deps_kernel import in_batch_graph
+        from accord_tpu.ops.encode import _pad_to, witness_mask
+        from accord_tpu.ops.wavefront import execution_waves
+
+        n = len(probes)
+        tokens = sorted({t for _, _, toks in probes for t in toks})
+        tindex = {t: i for i, t in enumerate(tokens)}
+        order = sorted(range(n), key=lambda i: probes[i][1])
+        b = _pad_to(n, 128)
+        kpad = _pad_to(len(tokens), 128)
+        txn_rank = np.full(b, -1, np.int32)
+        txn_wmask = np.zeros(b, np.int32)
+        txn_kind = np.zeros(b, np.int32)
+        touches = np.zeros((b, kpad), bool)
+        for rank, i in enumerate(order):
+            txn_id, _eat, toks = probes[i]
+            txn_rank[i] = rank
+            txn_wmask[i] = witness_mask(txn_id.kind)
+            txn_kind[i] = int(txn_id.kind)
+            for t in toks:
+                touches[i, tindex[t]] = True
+        dep_bb = in_batch_graph(jnp.asarray(txn_rank),
+                                jnp.asarray(txn_wmask),
+                                jnp.asarray(txn_kind),
+                                jnp.asarray(touches))
+        waves = np.asarray(execution_waves(dep_bb))[:n]
+        if self.verify:
+            self._verify_waves(probes, txn_rank, txn_wmask, txn_kind, waves)
+        self.device_wave_batches += 1
+        self.device_wave_planned += n
+        self.device_wave_max_depth = max(self.device_wave_max_depth,
+                                         int(waves.max()) + 1)
+        return {probes[i][0]: (int(waves[i]), probes[i][1])
+                for i in range(n)}
+
+    def _verify_waves(self, probes, txn_rank, txn_wmask, txn_kind, waves):
+        """Oracle-check the device wave assignment against the host
+        layering of the identically-defined conflict graph."""
+        from accord_tpu.ops.wavefront import waves_oracle
+
+        n = len(probes)
+        toksets = [set(toks) for _, _, toks in probes]
+        rows = []
+        for i in range(n):
+            deps = [j for j in range(n)
+                    if txn_rank[j] < txn_rank[i]
+                    and (toksets[i] & toksets[j])
+                    and ((txn_wmask[i] >> txn_kind[j]) & 1)]
+            rows.append(deps)
+        want = waves_oracle(rows)
+        got = [int(w) for w in waves]
+        if got != want:
+            err = AssertionError(
+                f"device waves diverge from host oracle: device={got} "
+                f"host={want}")
+            try:
+                self.agent.on_uncaught_exception(err)
+            except Exception:
+                pass
+            raise err
+
+    def _schedule_window(self, window, plan):
+        """Reorder the window: unplanned operations first in arrival order,
+        then the planned Applies by (wave, executeAt, arrival)."""
+        planned = []
+        rest = []
+        for idx, item in enumerate(window):
+            context = item[0]
+            key = None
+            for txn_id, _eat, _keys in context.execute_probes:
+                if txn_id in plan:
+                    key = plan[txn_id]
+                    break
+            if key is None:
+                rest.append(item)
+            else:
+                planned.append((key[0], key[1], idx, item))
+        planned.sort(key=lambda x: (x[0], x[1], x[2]))
+        return rest + [item for _, _, _, item in planned]
+
+    def _account_wave_execution(self, plan) -> None:
+        # plan membership implies the txn had NOT executed when the window
+        # was planned (_plan_waves filters already-APPLYING re-deliveries),
+        # so reaching APPLYING now means this window's schedule ran it
+        from accord_tpu.local.status import SaveStatus
+        for txn_id in plan:
+            cmd = self.commands.get(txn_id)
+            if cmd is not None \
+                    and cmd.save_status >= SaveStatus.APPLYING:
+                self.device_wave_executed += 1

@@ -112,6 +112,7 @@ def sweep(store) -> int:
         # witnessing this txn (universal tier installs the fence); at the
         # majority tier the command truncates but stays witnessable
         if decision == Cleanup.ERASE and txn_id in store.range_commands:
+            store.range_version += 1
             del store.range_commands[txn_id]
     # prune conflict indexes below each key's shard-applied fence: the fence
     # ESP witnessed everything below it on every replica AND preaccept refuses

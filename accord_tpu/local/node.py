@@ -429,9 +429,11 @@ class Node:
         participants = request.participants()
         probe = request.deps_probe()
         rprobe = request.recovery_probe()
+        xprobe = request.execute_probe()
         context = PreLoadContext.for_txn(
             request.txn_id, deps_probes=(probe,) if probe is not None else (),
-            recovery_probes=(rprobe,) if rprobe is not None else ())
+            recovery_probes=(rprobe,) if rprobe is not None else (),
+            execute_probes=(xprobe,) if xprobe is not None else ())
         stores = self.command_stores.intersecting(participants)
         if not stores:
             if reply_context is not None:

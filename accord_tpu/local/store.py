@@ -39,16 +39,22 @@ class PreLoadContext:
     active-conflict scans the operation will run, letting a batched device
     store precompute them for the whole flush window in one kernel call."""
 
-    __slots__ = ("txn_ids", "keys", "deps_probes", "recovery_probes")
+    __slots__ = ("txn_ids", "keys", "deps_probes", "recovery_probes",
+                 "execute_probes")
 
     def __init__(self, txn_ids: Sequence[TxnId] = (), keys=None,
-                 deps_probes: Sequence = (), recovery_probes: Sequence = ()):
+                 deps_probes: Sequence = (), recovery_probes: Sequence = (),
+                 execute_probes: Sequence = ()):
         self.txn_ids = tuple(txn_ids)
         self.keys = keys if keys is not None else Keys(())
         self.deps_probes = tuple(deps_probes)
         # (txn_id, Keys) of BeginRecovery's mapReduceFull predicate scans —
         # the batched device store precomputes them per flush window
         self.recovery_probes = tuple(recovery_probes)
+        # (txn_id, execute_at, Keys) of executions this operation delivers
+        # (Apply messages): the batched device store plans the window's
+        # apply order with the wavefront kernel (ops/wavefront.py)
+        self.execute_probes = tuple(execute_probes)
 
     @classmethod
     def empty(cls) -> "PreLoadContext":
@@ -57,8 +63,10 @@ class PreLoadContext:
     @classmethod
     def for_txn(cls, txn_id: TxnId, keys=None,
                 deps_probes: Sequence = (),
-                recovery_probes: Sequence = ()) -> "PreLoadContext":
-        return cls((txn_id,), keys, deps_probes, recovery_probes)
+                recovery_probes: Sequence = (),
+                execute_probes: Sequence = ()) -> "PreLoadContext":
+        return cls((txn_id,), keys, deps_probes, recovery_probes,
+                   execute_probes)
 
 
 class SafeCommandStore:
@@ -162,6 +170,7 @@ class SafeCommandStore:
                 u.callback(self)
 
     def register_range_txn(self, command: Command, ranges: Ranges) -> None:
+        self.store.range_version += 1
         self.store.range_commands[command.txn_id] = ranges.slice(self.ranges) \
             if not self.ranges.is_empty else ranges
 
@@ -460,6 +469,9 @@ class CommandStore:
         self.cfks: Dict[Key, CommandsForKey] = {}
         self.tfks: Dict[Key, TimestampsForKey] = {}
         self.range_commands: Dict[TxnId, Ranges] = {}
+        # bumped on any range_commands mutation (register/cleanup): the
+        # device store's batched range-stab probes are version-gated on it
+        self.range_version = 0
         self.max_conflicts = MaxConflicts()
         self.redundant_before = RedundantBefore()
         self.durable_before = DurableBefore()

@@ -67,8 +67,6 @@ class Accept(TxnRequest):
         return AcceptNack(outcome)
 
     def deps_probe(self):
-        if not isinstance(self.participating_keys, Keys):
-            return None
         return (self.execute_at, self.txn_id.kind.witnesses(),
                 self.participating_keys)
 

@@ -78,5 +78,15 @@ class Apply(TxnRequest):
         order = [ApplyReply.INSUFFICIENT, ApplyReply.APPLIED, ApplyReply.REDUNDANT]
         return a if order.index(a.outcome) <= order.index(b.outcome) else b
 
+    def execute_probe(self):
+        """The execution this Apply delivers, for the device store's
+        in-window wavefront scheduler (reference execution ordering:
+        Commands.maybeExecute :656 + NotifyWaitingOn :1011 walk one
+        command at a time; the device plans the whole window's order in
+        one kernel dispatch)."""
+        if not self.scope.is_key_domain:
+            return None  # range-domain executions stay on the scalar walk
+        return (self.txn_id, self.execute_at, self.scope.participant_keys())
+
     def __repr__(self):
         return f"Apply({self.kind.name}, {self.txn_id!r}@{self.execute_at!r})"

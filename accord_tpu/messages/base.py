@@ -162,6 +162,13 @@ class TxnRequest(Request):
         (PreLoadContext.recovery_probes, ops/recovery_kernel.py)."""
         return None
 
+    def execute_probe(self):
+        """(txn_id, execute_at, data Keys) of the execution this message
+        delivers (Apply), or None — the batched device store plans the
+        flush window's apply order with the wavefront kernel
+        (PreLoadContext.execute_probes, ops/wavefront.py)."""
+        return None
+
 
 class SimpleReply(Reply):
     type = MessageType.SIMPLE_RSP

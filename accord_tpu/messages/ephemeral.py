@@ -47,9 +47,8 @@ class GetEphemeralReadDeps(TxnRequest):
         self.keys = keys
 
     def deps_probe(self):
-        if not isinstance(self.keys, Keys):
-            return None
-        return (Timestamp.max_value(), self.txn_id.kind.witnesses(), self.keys)
+        return (Timestamp.max_value(), self.txn_id.kind.witnesses(),
+                self.keys)
 
     def apply(self, safe_store) -> Reply:
         deps = C.calculate_deps(safe_store, self.txn_id, self.keys,

@@ -35,8 +35,6 @@ class GetDeps(TxnRequest):
         self.before = before
 
     def deps_probe(self):
-        if not isinstance(self.keys, Keys):
-            return None
         return (self.before, self.txn_id.kind.witnesses(), self.keys)
 
     def apply(self, safe_store) -> Reply:

@@ -61,10 +61,11 @@ class PreAccept(TxnRequest):
         return PreAcceptNack()
 
     def deps_probe(self):
-        keys = self.partial_txn.keys
-        if not isinstance(keys, Keys):
-            return None  # range-domain: the RangeDeps tier stays scalar
-        return (self.txn_id, self.txn_id.kind.witnesses(), keys)
+        # Keys OR Ranges: the key tier serves Keys probes from the batched
+        # CFK kernel; the range-stab tier (ops/range_kernel.py) serves the
+        # range-command arm for both domains
+        return (self.txn_id, self.txn_id.kind.witnesses(),
+                self.partial_txn.keys)
 
     def reduce(self, a: Reply, b: Reply) -> Reply:
         if isinstance(a, PreAcceptNack):

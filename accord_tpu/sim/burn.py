@@ -356,6 +356,7 @@ def main(argv=None) -> int:
         extra = ""
         if args.device_store:
             h = m = b = p = rh = rm = dis = 0
+            wb = wp = wx = wd = gh = gm = 0
             mx = 0
             for node in run.cluster.nodes.values():
                 for s in node.command_stores.all():
@@ -366,10 +367,19 @@ def main(argv=None) -> int:
                     mx = max(mx, s.device_max_batch)
                     rh += s.device_recovery_hits
                     rm += s.device_recovery_misses
+                    wb += s.device_wave_batches
+                    wp += s.device_wave_planned
+                    wx += s.device_wave_executed
+                    wd = max(wd, s.device_wave_max_depth)
+                    gh += s.device_range_hits
+                    gm += s.device_range_misses
                     dis += s.device_disabled
             extra = (f" device[hits={h} misses={m} batches={b} "
                      f"probes={p} max_batch={mx} "
-                     f"recovery_hits={rh} recovery_misses={rm}"
+                     f"recovery_hits={rh} recovery_misses={rm} "
+                     f"wave_batches={wb} wave_planned={wp} "
+                     f"wave_executed={wx} wave_depth={wd} "
+                     f"range_hits={gh} range_misses={gm}"
                      + (f" DISABLED={dis}" if dis else "") + "]")
         def lat(pct):
             us = stats.latency_us(pct)

@@ -1007,6 +1007,19 @@ def fill_device_rows(max_wait_s: float, only=None) -> int:
     from accord_tpu.utils.backend import resolve_platform
 
     here = os.path.dirname(os.path.abspath(__file__))
+    # resolve_platform's CPU fallback mutates JAX_PLATFORMS in THIS process
+    # (required for in-process jax use; poisonous for a long-lived prober):
+    # snapshot the ambient platform and restore before every probe, and run
+    # the config subprocesses under the pristine environment
+    ambient_platform = os.environ.get("JAX_PLATFORMS")
+    ambient_env = dict(os.environ)
+
+    def probe_platform() -> str:
+        if ambient_platform is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = ambient_platform
+        return resolve_platform()
     pending = [(c, t) for c, t in FILL_CONFIGS
                if only is None or c in only]
     rows = _load_rows()
@@ -1015,7 +1028,7 @@ def fill_device_rows(max_wait_s: float, only=None) -> int:
     deadline = time.time() + max_wait_s
     backoff = 60.0
     while pending and time.time() < deadline:
-        platform = resolve_platform()
+        platform = probe_platform()
         if platform.startswith("cpu"):
             wait = min(backoff, max(0.0, deadline - time.time()))
             print(f"# tunnel dead ({platform}); {len(pending)} rows "
@@ -1034,7 +1047,8 @@ def fill_device_rows(max_wait_s: float, only=None) -> int:
             proc = subprocess.run(
                 [sys.executable, os.path.join(here, "bench.py"),
                  "--config", cfg, "--json-out", out_path],
-                timeout=tmo, capture_output=True, text=True, cwd=here)
+                timeout=tmo, capture_output=True, text=True, cwd=here,
+                env=ambient_env)
         except subprocess.TimeoutExpired:
             print(f"# {cfg} timed out after {tmo}s (tunnel flap?); "
                   f"will retry", flush=True)
