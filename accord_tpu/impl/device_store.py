@@ -472,7 +472,8 @@ class DeviceCommandStore(CommandStore):
             if plan is not None:
                 self._account_wave_execution(plan)
 
-    def _precompute(self, window) -> None:
+    def _collect_deps_probes(self, window
+                             ) -> List[Tuple[Timestamp, KindSet, List[Key]]]:
         probes: List[Tuple[Timestamp, KindSet, List[Key]]] = []
         seen: Set[Tuple[Timestamp, KindSet]] = set()
         for context, _fn, _result in window:
@@ -485,13 +486,9 @@ class DeviceCommandStore(CommandStore):
                     continue
                 seen.add((before, kinds))
                 probes.append((before, kinds, list(owned)))
-        self._precomputed = {}
-        if not probes:
-            return
+        return probes
 
-        from accord_tpu.ops.deps_kernel import batched_active_deps
-        from accord_tpu.ops.encode import BatchEncoder
-
+    def _probe_snapshots(self, probes):
         touched = sorted({k for _, _, ks in probes for k in ks})
         cfks = [self.cfks[k] for k in touched if k in self.cfks]
         versions = {k: (self.cfks[k].version if k in self.cfks else 0)
@@ -499,18 +496,34 @@ class DeviceCommandStore(CommandStore):
         committed_versions = {
             k: (self.cfks[k].committed_version if k in self.cfks else 0)
             for k in touched}
-        enc = BatchEncoder.for_probes(cfks, probes)
-        s, b = enc.state, enc.dbatch
-        dep_mask, _count = batched_active_deps(
-            s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
-            s.entry_kind, b.txn_rank, b.txn_witness_mask, b.touches)
-        keyed = enc.decode_key_deps(np.asarray(dep_mask))
+        return cfks, versions, committed_versions
+
+    def _install_probes(self, probes, keyed, versions,
+                        committed_versions) -> None:
         self.device_batches += 1
         self.device_batched_probes += len(probes)
         self.device_max_batch = max(self.device_max_batch, len(probes))
         for (before, kinds, ks), m in zip(probes, keyed):
             self._precomputed[(before, kinds)] = _Probe(
                 before, kinds, m, set(ks), versions, committed_versions)
+
+    def _precompute(self, window) -> None:
+        self._precomputed = {}
+        probes = self._collect_deps_probes(window)
+        if not probes:
+            return
+
+        from accord_tpu.ops.deps_kernel import batched_active_deps
+        from accord_tpu.ops.encode import BatchEncoder
+
+        cfks, versions, committed_versions = self._probe_snapshots(probes)
+        enc = BatchEncoder.for_probes(cfks, probes)
+        s, b = enc.state, enc.dbatch
+        dep_mask, _count = batched_active_deps(
+            s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
+            s.entry_kind, b.txn_rank, b.txn_witness_mask, b.touches)
+        keyed = enc.decode_key_deps(np.asarray(dep_mask))
+        self._install_probes(probes, keyed, versions, committed_versions)
 
     def _precompute_recovery(self, window) -> None:
         """Batch every declared recovery probe (BeginRecovery's four
@@ -777,3 +790,71 @@ class DeviceCommandStore(CommandStore):
             if cmd is not None \
                     and cmd.save_status >= SaveStatus.APPLYING:
                 self.device_wave_executed += 1
+
+
+class MeshDeviceCommandStore(DeviceCommandStore):
+    """DeviceCommandStore whose batched deps precompute runs the
+    mesh-sharded SPMD step over a `jax.sharding.Mesh`
+    (ops/sharded.make_sharded_step: per-shard deps masks, psum'd counts,
+    psum-of-matmuls conflict graph — the collective layout of the
+    reference's CommandStores shard fan-out, CommandStores.java:78,
+    mapped onto ICI instead of an executor pool).
+
+    The protocol semantics are identical to DeviceCommandStore — same
+    probe declarations, same serving, same version gates, same inline
+    verification — only the kernel executing the window's deps scans is
+    the multi-device step.  On a single-device backend it degrades to the
+    parent's single-chip path."""
+
+    def __init__(self, store_id: int, node, ranges, *,
+                 flush_window_us: int = 0, verify: bool = False,
+                 mesh=None, sharded_step=None, n_shards: int = 0):
+        super().__init__(store_id, node, ranges,
+                         flush_window_us=flush_window_us, verify=verify)
+        self.mesh = mesh
+        self._sharded_step = sharded_step
+        self._mesh_shards = n_shards
+
+    @classmethod
+    def factory(cls, flush_window_us: int = 0, verify: bool = False,
+                mesh=None):
+        """One mesh + one compiled step shared by every store the factory
+        creates (a per-store shard_map closure would recompile per store).
+        With no mesh and a single-device backend, stores run the parent's
+        single-chip path."""
+        import jax
+
+        if mesh is None and len(jax.devices()) > 1:
+            from jax.sharding import Mesh
+            mesh = Mesh(np.array(jax.devices()), ("shard",))
+        step = None
+        n_shards = 0
+        if mesh is not None:
+            from accord_tpu.ops.sharded import make_sharded_deps_step
+            step = make_sharded_deps_step(mesh)
+            n_shards = mesh.devices.size
+        return lambda i, node, ranges: cls(
+            i, node, ranges, flush_window_us=flush_window_us, verify=verify,
+            mesh=mesh, sharded_step=step, n_shards=n_shards)
+
+    def _precompute(self, window) -> None:
+        if self._sharded_step is None:
+            return super()._precompute(window)
+        self._precomputed = {}
+        probes = self._collect_deps_probes(window)
+        if not probes:
+            return
+
+        from accord_tpu.ops.encode import PAD
+        from accord_tpu.ops.sharded import ShardedEncoder
+
+        cfks, versions, committed_versions = self._probe_snapshots(probes)
+        # PAD-granular shape bucketing (not the encoder's default pad=8):
+        # each distinct shape recompiles the shared jitted SPMD step
+        enc = ShardedEncoder.for_probes(cfks, probes,
+                                        n_shards=self._mesh_shards, pad=PAD)
+        args = enc.args()
+        dep_mask, _count = self._sharded_step(
+            *args[:5], args[5], args[6], args[8])
+        keyed = enc.decode_key_deps(np.asarray(dep_mask))
+        self._install_probes(probes, keyed, versions, committed_versions)

@@ -93,6 +93,29 @@ def make_sharded_step(mesh: Mesh, axis: str = "shard"):
     return jax.jit(fn)
 
 
+def make_sharded_deps_step(mesh: Mesh, axis: str = "shard"):
+    """Deps-only variant of make_sharded_step for the device store's flush
+    windows: per-shard dependency masks + psum'd counts, WITHOUT the
+    conflict-graph matmul/psum or the wavefront fixpoint (probes are
+    txn-agnostic scans — the store plans execution separately from its
+    execute probes, so computing graph/waves here would be discarded
+    work on the hot path)."""
+
+    def _local(entry_rank, entry_eat_rank, entry_key, entry_status,
+               entry_kind, txn_rank, txn_witness_mask, touches):
+        dep_mask, dep_count_local = batched_active_deps(
+            entry_rank[0], entry_eat_rank[0], entry_key[0], entry_status[0],
+            entry_kind[0], txn_rank, txn_witness_mask, touches)
+        return dep_mask[None], jax.lax.psum(dep_count_local, axis)
+
+    fn = shard_map(
+        _local, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis),
+                  P(), P(), P(None, axis)),
+        out_specs=(P(axis), P()))
+    return jax.jit(fn)
+
+
 class ShardedEncoder:
     """Key-block layout for the sharded step.
 
@@ -106,13 +129,32 @@ class ShardedEncoder:
     def __init__(self, cfks: Sequence[CommandsForKey],
                  batch: Sequence[Tuple[TxnId, Sequence[Key]]],
                  n_shards: int, pad: int = 8):
+        self._init(cfks, batch,
+                   [(tid, witness_mask(tid.kind), int(tid.kind), ks)
+                    for tid, ks in batch], n_shards, pad)
+
+    @classmethod
+    def for_probes(cls, cfks: Sequence[CommandsForKey], probes,
+                   n_shards: int, pad: int = 8) -> "ShardedEncoder":
+        """Encode deps probes — (before, witness KindSet, keys) — instead of
+        new txns (the same txn-agnostic probe contract as
+        BatchEncoder.for_probes; the device store's flush windows use it)."""
+        from accord_tpu.ops.encode import kinds_mask
+        self = cls.__new__(cls)
+        self._init(cfks, probes,
+                   [(before, kinds_mask(kinds), 0, ks)
+                    for before, kinds, ks in probes], n_shards, pad)
+        return self
+
+    def _init(self, cfks, batch, rows, n_shards: int, pad: int) -> None:
         self.n_shards = n_shards
         self.batch = list(batch)
-        keys = sorted({c.key for c in cfks} | {k for _, ks in batch for k in ks})
+        keys = sorted({c.key for c in cfks}
+                      | {k for _, _, _, ks in rows for k in ks})
         per_key: Dict[Key, CommandsForKey] = {c.key: c for c in cfks}
         from accord_tpu.ops.encode import collect_universe
         self.universe, self.rank = collect_universe(
-            cfks, [tid for tid, _ in batch])
+            cfks, [ts for ts, _, _, _ in rows])
 
         # contiguous key blocks
         blocks: List[List[Key]] = [[] for _ in range(n_shards)]
@@ -149,7 +191,7 @@ class ShardedEncoder:
                 self.entry_status[s, i] = status
                 self.entry_kind[s, i] = int(tid.kind)
 
-        b = _pad_to(max(1, len(batch)), pad)
+        b = _pad_to(max(1, len(rows)), pad)
         self.txn_rank = np.full(b, -1, np.int32)
         self.txn_witness_mask = np.zeros(b, np.int32)
         self.txn_kind = np.zeros(b, np.int32)
@@ -159,10 +201,10 @@ class ShardedEncoder:
         for s, blk in enumerate(blocks):
             for li, k in enumerate(blk):
                 key_slot[k] = s * ks + li
-        for i, (tid, keyset) in enumerate(batch):
-            self.txn_rank[i] = self.rank[tid]
-            self.txn_witness_mask[i] = witness_mask(tid.kind)
-            self.txn_kind[i] = int(tid.kind)
+        for i, (ts, wmask, kind, keyset) in enumerate(rows):
+            self.txn_rank[i] = self.rank[ts]
+            self.txn_witness_mask[i] = wmask
+            self.txn_kind[i] = kind
             for k in keyset:
                 self.touches[i, key_slot[k]] = True
 
@@ -181,4 +223,19 @@ class ShardedEncoder:
                 for e in np.nonzero(row[:len(es)])[0]:
                     ids.add(es[e][1])
             out.append(sorted(ids))
+        return out
+
+    def decode_key_deps(self, dep_mask: np.ndarray
+                        ) -> List[Dict[Key, List[TxnId]]]:
+        """[S, B, Es] -> per-probe {key: sorted dep ids} maps (the device
+        store's serving format, mirroring BatchEncoder.decode_key_deps)."""
+        out: List[Dict[Key, List[TxnId]]] = []
+        for b in range(len(self.batch)):
+            m: Dict[Key, List[TxnId]] = {}
+            for s, es in enumerate(self.entries_per):
+                row = dep_mask[s, b]
+                for e in np.nonzero(row[:len(es)])[0]:
+                    li, tid, _, _ = es[e]
+                    m.setdefault(self.blocks[s][li], []).append(tid)
+            out.append({k: sorted(v) for k, v in m.items()})
         return out

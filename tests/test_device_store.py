@@ -172,3 +172,26 @@ def test_deep_flush_windows_stay_verified():
     assert stats.pending == 0
     assert stats.acks > 0
     assert mb >= 4, f"window never batched deeply (max_batch={mb})"
+
+
+def test_mesh_store_serves_burn_through_sharded_step():
+    """MeshDeviceCommandStore runs the window's deps scans through the
+    mesh-sharded SPMD step (ops/sharded.make_sharded_step) over the
+    8-device virtual CPU mesh, protocol-path end to end, with inline
+    scalar verification on every served scan (VERDICT r3 item 4)."""
+    import jax
+
+    from accord_tpu.impl.device_store import MeshDeviceCommandStore
+    from accord_tpu.sim.burn import BurnRun
+
+    assert len(jax.devices()) >= 8, "conftest must provide the virtual mesh"
+    run = BurnRun(62, 60, nodes=3, keys=8, drop_prob=0.0,
+                  store_factory=MeshDeviceCommandStore.factory(
+                      flush_window_us=300, verify=True))
+    stats = run.run()
+    assert stats.acks > 0
+    assert stats.lost == 0 and stats.pending == 0
+    stores = [s for node in run.cluster.nodes.values()
+              for s in node.command_stores.all()]
+    assert all(s.mesh is not None for s in stores)
+    assert sum(s.device_hits for s in stores) > 0
