@@ -15,7 +15,8 @@ from accord_tpu.coordinate.errors import Exhausted, Invalidated, Preempted, Time
 from accord_tpu.coordinate.tracking import (AppliedTracker, QuorumTracker,
                                             RequestStatus)
 from accord_tpu.messages.accept import Accept, AcceptNack, AcceptOk
-from accord_tpu.messages.apply_msg import Apply, ApplyKind, ApplyReply
+from accord_tpu.messages.apply_msg import (Apply, ApplyKind, ApplyReply,
+                                           ApplyThenWaitUntilApplied)
 from accord_tpu.messages.base import Callback, RoundCallback, TxnRequest
 from accord_tpu.messages.commit import Commit, CommitKind
 from accord_tpu.messages.read import ReadNack, ReadOk, ReadTxnData
@@ -327,6 +328,14 @@ class ExecutePath(Callback):
         # logs stand down (the reference Persist round, Persist.java)
         self.applied_tracker = AppliedTracker(topologies)
         apply_cb = RoundCallback(self, "apply")
+        # Sync points awaiting application use the fused verb: the replica
+        # acks only once the sync point has APPLIED locally (its deps
+        # drained), giving the applied_result the reference's
+        # ExecuteSyncPoint semantics in ONE round instead of Apply +
+        # WaitUntilApplied (ApplyThenWaitUntilApplied.java:37).  A plain
+        # Apply ack only confirms the outcome was INSTALLED.
+        fused = self.txn.kind.is_sync_point and self.applied_result is not None
+        msg_cls = ApplyThenWaitUntilApplied if fused else Apply
         for to in topologies.nodes():
             scope = TxnRequest.compute_scope(to, topologies, self.route)
             if scope is None:
@@ -334,9 +343,9 @@ class ExecutePath(Callback):
             partial = (self.txn.slice(scope.covering(), include_query=False)
                        if maximal else None)
             self.node.send(
-                to, Apply(self.apply_kind, self.txn_id, scope,
-                          self.execute_at, self.deps, writes, result,
-                          partial_txn=partial, full_route=self.route),
+                to, msg_cls(self.apply_kind, self.txn_id, scope,
+                            self.execute_at, self.deps, writes, result,
+                            partial_txn=partial, full_route=self.route),
                 callback=apply_cb)
         self.result.try_success(result)
 

@@ -108,48 +108,6 @@ class CoordinateSyncPoint(CoordinateTransaction):
         self._sp_result.try_failure(failure)
 
 
-class _AwaitAppliedQuorum:
-    """WaitUntilApplied at a quorum per shard of the sync point's route
-    (ExecuteSyncPoint.java semantics)."""
-
-    def __init__(self, node, sp: SyncPoint, result: AsyncResult):
-        self.node = node
-        self.sp = sp
-        self.result = result
-        self.tracker = None
-        self.done = False
-
-    def start(self) -> None:
-        from accord_tpu.coordinate.tracking import QuorumTracker
-        from accord_tpu.messages.wait import WaitUntilApplied
-        sp = self.sp
-        topologies = self.node.topology.with_unsynced_epochs(
-            sp.route.participants(), sp.txn_id.epoch, sp.execute_at.epoch)
-        self.tracker = QuorumTracker(topologies)
-        self.node.send_to_route(
-            sp.route, sp.txn_id.epoch, sp.execute_at.epoch,
-            lambda to, scope: WaitUntilApplied(sp.txn_id, scope),
-            callback=self)
-
-    def on_success(self, from_id: int, reply) -> None:
-        from accord_tpu.coordinate.tracking import RequestStatus
-        if self.done:
-            return
-        if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
-            self.done = True
-            self.result.try_success(self.sp)
-
-    def on_failure(self, from_id: int, failure: BaseException) -> None:
-        from accord_tpu.coordinate.errors import Exhausted, Timeout
-        from accord_tpu.coordinate.tracking import RequestStatus
-        if self.done:
-            return
-        if self.tracker.record_failure(from_id) == RequestStatus.FAILED:
-            self.done = True
-            self.result.try_failure(failure if isinstance(failure, Timeout)
-                                    else Exhausted(repr(failure)))
-
-
 class BarrierType(enum.Enum):
     """Barrier.BarrierType (Barrier.java:64)."""
     LOCAL = "LOCAL"
@@ -165,20 +123,13 @@ def barrier(node, seekables, barrier_type: BarrierType) -> AsyncResult:
               else seekables.to_ranges())
     if barrier_type == BarrierType.GLOBAL_SYNC:
         # Apply acks only certify the outcome was recorded; a sync barrier
-        # needs actual execution (deps drained), so wait on WaitUntilApplied
-        # at a quorum per shard (the reference ExecuteSyncPoint)
-        result: AsyncResult = AsyncResult()
-        sp_result = CoordinateSyncPoint.coordinate(
-            node, TxnKind.SYNC_POINT, ranges, await_applied=False)
-
-        def on_sp(sp: SyncPoint, failure):
-            if failure is not None:
-                result.try_failure(failure)
-                return
-            _AwaitAppliedQuorum(node, sp, result).start()
-
-        sp_result.add_callback(on_sp)
-        return result
+        # needs actual execution (deps drained).  await_applied=True makes
+        # the persist round send the FUSED ApplyThenWaitUntilApplied, whose
+        # ack arrives only once the sync point APPLIES at the replica — the
+        # reference ExecuteSyncPoint semantics in one round instead of
+        # Apply + a separate WaitUntilApplied quorum.
+        return CoordinateSyncPoint.coordinate(
+            node, TxnKind.SYNC_POINT, ranges, await_applied=True)
     if barrier_type == BarrierType.GLOBAL_ASYNC:
         return CoordinateSyncPoint.coordinate(
             node, TxnKind.SYNC_POINT, ranges, await_applied=False)

@@ -71,6 +71,7 @@ class SimpleProgressLog(ProgressLog):
         self.store = store
         self.home: Dict[TxnId, _HomeState] = {}
         self.blocked: Dict[TxnId, _BlockedState] = {}
+        self._informed_home: set = set()
         delay = node.config.progress_log_schedule_delay_s
         self._delay_s = delay
         # stagger replicas so they do not duel over recovery ballots
@@ -123,10 +124,38 @@ class SimpleProgressLog(ProgressLog):
         if command.durability.is_durable:
             self.home.pop(command.txn_id, None)
             # blocked waits are local; see update()
+            if command.txn_id in self.blocked and not self._is_home(command):
+                # home short-circuit (InformHomeDurable.java:30), CHASE
+                # path only: we were blocked on this txn and durability
+                # arrived while the system was degraded — the home shard's
+                # monitor may have missed its own broadcast and still be
+                # chasing a settled txn; re-inform it (once per txn).  The
+                # happy path (durability via the Persist tail's broadcast,
+                # no local chase) never sends: home got the same broadcast.
+                self._inform_home_durable(command)
+
+    def _inform_home_durable(self, command) -> None:
+        txn_id = command.txn_id
+        route = command.route
+        if route is None or txn_id in self._informed_home:
+            return
+        self._informed_home.add(txn_id)
+        from accord_tpu.messages.durability import InformHomeDurable
+        from accord_tpu.primitives.keys import Route, RoutingKeys
+        home_route = Route(route.home_key,
+                           keys=RoutingKeys([route.home_key]),
+                           is_full=False)
+        durability = command.durability
+        execute_at = command.execute_at
+        self.node.send_to_route(
+            home_route, txn_id.epoch, txn_id.epoch,
+            lambda to, scope: InformHomeDurable(txn_id, scope, execute_at,
+                                                durability))
 
     def clear(self, txn_id: TxnId) -> None:
         self.home.pop(txn_id, None)
         self.blocked.pop(txn_id, None)
+        self._informed_home.discard(txn_id)
 
     # -------------------------------------------------------------- polling --
     def _run(self) -> None:

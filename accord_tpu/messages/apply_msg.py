@@ -90,3 +90,42 @@ class Apply(TxnRequest):
 
     def __repr__(self):
         return f"Apply({self.kind.name}, {self.txn_id!r}@{self.execute_at!r})"
+
+
+class ApplyThenWaitUntilApplied(Apply):
+    """Apply the outcome AND reply only once the command has applied
+    locally — commit, (trivial) execute, and apply fused into one
+    request/response (reference accord/messages/
+    ApplyThenWaitUntilApplied.java:37, used by sync-point execution,
+    coordinate/ExecuteSyncPoint.java:66).
+
+    A sync point carries no writes; it reaches APPLIED exactly when its
+    dependencies drain on this replica, so acking at APPLIED is the
+    reference's "return when the dependencies are Applied" — and it saves
+    the separate WaitUntilApplied round the unfused path pays (reference
+    impl/AbstractFetchCoordinator.java:215 uses the same fusion on the
+    bootstrap path).  An INSUFFICIENT outcome still nacks immediately so
+    the coordinator can escalate to a maximal apply."""
+
+    def __init__(self, kind: ApplyKind, txn_id: TxnId, scope: Route,
+                 execute_at: Timestamp, deps: Optional[Deps],
+                 writes: Optional[Writes], result,
+                 partial_txn: Optional[PartialTxn] = None,
+                 full_route: Route = None):
+        super().__init__(kind, txn_id, scope, execute_at, deps, writes,
+                         result, partial_txn=partial_txn,
+                         full_route=full_route)
+        self.type = MessageType.APPLY_THEN_WAIT_UNTIL_APPLIED_REQ
+
+    def apply(self, safe_store):
+        from accord_tpu.messages.wait import await_applied
+
+        reply = super().apply(safe_store)
+        if reply.outcome == ApplyReply.INSUFFICIENT:
+            return reply
+        return await_applied(safe_store, self.txn_id,
+                             self.scope.participants(), reply)
+
+    def __repr__(self):
+        return (f"ApplyThenWaitUntilApplied({self.kind.name}, "
+                f"{self.txn_id!r}@{self.execute_at!r})")

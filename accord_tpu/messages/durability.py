@@ -40,6 +40,37 @@ class InformDurable(TxnRequest):
         return f"InformDurable({self.txn_id!r}, {self.durability.name})"
 
 
+class InformHomeDurable(TxnRequest):
+    """Tell the HOME shard a txn is durable so its progress-log monitor
+    stands down without waiting to observe durability itself (reference
+    accord/messages/InformHomeDurable.java:30: set the durability class at
+    the home key's store, skipping truncated commands).  Sent by a
+    non-home replica whose blocked-state chase learns a durable outcome
+    (impl/progress_log.py) — the home-specific short-circuit on top of the
+    participant-wide InformDurable the Persist tail broadcasts."""
+
+    type = MessageType.INFORM_HOME_DURABLE_REQ
+
+    def __init__(self, txn_id: TxnId, scope: Route, execute_at,
+                 durability: Durability):
+        super().__init__(txn_id, scope)
+        self.execute_at = execute_at
+        self.durability = durability
+
+    def apply(self, safe_store) -> Reply:
+        cmd = safe_store.get(self.txn_id)
+        if cmd.is_truncated:
+            return SimpleReply(SimpleReply.OK)
+        C.set_durability(safe_store, self.txn_id, self.durability)
+        return SimpleReply(SimpleReply.OK)
+
+    def reduce(self, a, b):
+        return a
+
+    def __repr__(self):
+        return f"InformHomeDurable({self.txn_id!r}, {self.durability.name})"
+
+
 class InformOfTxnId(TxnRequest):
     """Make sure the home shard knows a txn exists, so its progress log
     monitors it (InformOfTxnId.java / InformHomeOfTxn)."""

@@ -34,6 +34,21 @@ class _NotifyOnCommit(TransientListener):
             self.result.try_success(SimpleReply(SimpleReply.OK))
 
 
+def await_applied(safe_store, txn_id: TxnId, participants, reply):
+    """Shared wait tail: resolve with `reply` once txn_id has APPLIED
+    locally, nudging the progress log if it isn't even STABLE yet.  Used
+    by WaitUntilApplied and the fused ApplyThenWaitUntilApplied."""
+    from accord_tpu.local.command import OnAppliedListener
+    command = safe_store.get(txn_id)
+    result: AsyncResult = AsyncResult()
+    listener = OnAppliedListener.arm(
+        command, lambda c: result.try_success(reply))
+    if not listener.fired and not command.has_been(SaveStatus.STABLE):
+        safe_store.progress_log.waiting(
+            txn_id, safe_store.store, "Applied", command.route, participants)
+    return result
+
+
 class WaitUntilApplied(TxnRequest):
     """Block until the txn has applied locally, then ack
     (accord/messages/WaitUntilApplied — WAIT_UNTIL_APPLIED_REQ). Used by
@@ -46,16 +61,9 @@ class WaitUntilApplied(TxnRequest):
         super().__init__(txn_id, scope)
 
     def apply(self, safe_store):
-        from accord_tpu.local.command import OnAppliedListener
-        command = safe_store.get(self.txn_id)
-        result: AsyncResult = AsyncResult()
-        listener = OnAppliedListener.arm(
-            command, lambda c: result.try_success(SimpleReply(SimpleReply.OK)))
-        if not listener.fired and not command.has_been(SaveStatus.STABLE):
-            safe_store.progress_log.waiting(
-                self.txn_id, safe_store.store, "Applied", command.route,
-                self.scope.participants())
-        return result
+        return await_applied(safe_store, self.txn_id,
+                             self.scope.participants(),
+                             SimpleReply(SimpleReply.OK))
 
     def reduce(self, a, b):
         return b

@@ -134,3 +134,92 @@ class TestBurnWithDurability:
                                            SaveStatus.ERASED):
                         truncated += 1
         assert truncated > 0, "durability scheduling never truncated anything"
+
+
+class TestInformHomeDurable:
+    def test_chased_durability_reinforms_home(self):
+        """A non-home replica whose blocked-state chase learns a txn is
+        durable sends InformHomeDurable to the home shard (reference
+        InformHomeDurable.java:30).  The happy path (durability via the
+        Persist broadcast, no local chase) must NOT send — home received
+        the same broadcast (no steady-state message amplification)."""
+        from accord_tpu.impl.progress_log import SimpleProgressLog, _BlockedState
+        from accord_tpu.messages.durability import InformHomeDurable
+        from accord_tpu.local.status import Durability
+
+        cluster = SimCluster(n_nodes=3, seed=55, n_shards=2,
+                             num_command_stores=2,
+                             progress_log_factory=SimpleProgressLog)
+        run(cluster, cluster.node(1).coordinate(write_txn({5: 1, 600: 2})))
+        cluster.process_all()
+
+        sent = []
+        node = cluster.node(2)
+        orig_send = node.send
+        node.send = lambda to, msg, callback=None: (
+            sent.append(msg) if isinstance(msg, InformHomeDurable)
+            else orig_send(to, msg, callback=callback))
+        # find a store that owns token 600 but not the home key (token 5)
+        target = None
+        for store in node.command_stores.all():
+            for t, cmd in store.commands.items():
+                if t.kind == TxnKind.WRITE and cmd.durability.is_durable \
+                        and cmd.route is not None \
+                        and not store.ranges.contains(cmd.route.home_key):
+                    target = (store, cmd)
+        assert target is not None, "no non-home durable replica found"
+        store, cmd = target
+        log = store.progress_log
+        # happy path: durable() with no chase underway -> no send
+        log.durable(cmd)
+        assert sent == []
+        # chase path: a blocked state exists -> the short-circuit fires once
+        log.blocked[cmd.txn_id] = _BlockedState(
+            cmd.txn_id, cmd.route, "Applied", 0.0, None)
+        log.durable(cmd)
+        assert len(sent) >= 1 and all(
+            m.txn_id == cmd.txn_id for m in sent), sent
+        n_first = len(sent)
+        log.durable(cmd)  # deduped
+        assert len(sent) == n_first
+
+
+class TestApplyThenWaitUntilApplied:
+    def test_global_sync_barrier_uses_fused_verb(self):
+        """GLOBAL_SYNC barriers persist through ApplyThenWaitUntilApplied:
+        the replica acks only after the sync point APPLIES locally (deps
+        drained) — reference ExecuteSyncPoint.java:66 semantics fused into
+        one round.  Asserts the fused verb actually flows and that the
+        barrier resolution implies quorum application."""
+        from accord_tpu.coordinate.syncpoint import BarrierType, barrier
+        from accord_tpu.messages.apply_msg import ApplyThenWaitUntilApplied
+        from accord_tpu.primitives.keys import Ranges
+
+        served = [0]
+        orig_apply = ApplyThenWaitUntilApplied.apply
+
+        def spy(self, safe_store):
+            served[0] += 1
+            return orig_apply(self, safe_store)
+
+        ApplyThenWaitUntilApplied.apply = spy
+        try:
+            cluster = SimCluster(n_nodes=3, seed=56, n_shards=2)
+            run(cluster, cluster.node(1).coordinate(write_txn({9: 4})))
+            b = barrier(cluster.node(2), Ranges.of((0, 1000)),
+                        BarrierType.GLOBAL_SYNC)
+            sp = run(cluster, b)
+        finally:
+            ApplyThenWaitUntilApplied.apply = orig_apply
+        assert served[0] > 0, "fused verb never applied at any replica"
+        # the sync point itself is APPLIED (not merely installed) at a
+        # quorum the moment the barrier resolves — the fused verb's ack
+        applied = 0
+        for node in cluster.nodes.values():
+            for store in node.command_stores.all():
+                cmd = store.commands.get(sp.txn_id)
+                if cmd is not None and cmd.has_been(SaveStatus.APPLIED):
+                    applied += 1
+        assert applied >= 2, (
+            "fused ApplyThenWaitUntilApplied did not gate the barrier on "
+            "local application")

@@ -63,11 +63,14 @@ class TestWireRoundTrips:
         from accord_tpu.messages import base as mb
         from accord_tpu.messages.accept import (Accept, AcceptInvalidate,
                                                 AcceptOk)
-        from accord_tpu.messages.apply_msg import Apply, ApplyKind, ApplyReply
+        from accord_tpu.messages.apply_msg import (Apply, ApplyKind,
+                                                   ApplyReply,
+                                                   ApplyThenWaitUntilApplied)
         from accord_tpu.messages.checkstatus import CheckStatus, IncludeInfo
         from accord_tpu.messages.commit import (Commit, CommitInvalidate,
                                                 CommitKind)
         from accord_tpu.messages.durability import (InformDurable,
+                                                    InformHomeDurable,
                                                     InformOfTxnId,
                                                     QueryDurableBefore,
                                                     QueryDurableBeforeOk,
@@ -115,6 +118,9 @@ class TestWireRoundTrips:
             CommitInvalidate(t, scope),
             Apply(ApplyKind.MINIMAL, t, scope, ts, deps, writes, result),
             ApplyReply(ApplyReply.APPLIED),
+            ApplyThenWaitUntilApplied(ApplyKind.MAXIMAL, t, scope, ts, deps,
+                                      writes, result, partial_txn=part),
+            InformHomeDurable(t, scope, ts, Durability.MAJORITY),
             ReadTxnData(t, scope, Keys.of(1), 1),
             ReadOk(ListData({Key(1): (4,)})),
             ReadNack(ReadNack.NOT_COMMITTED),
