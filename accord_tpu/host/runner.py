@@ -217,7 +217,10 @@ class MaelstromRunner:
 
     def check_strict_serializability(self, n_keys: int) -> int:
         final = self.final_histories(n_keys)
-        verifier = StrictSerializabilityVerifier()
+        from accord_tpu.sim.elle import ElleListAppendChecker
+        from accord_tpu.sim.verify_replay import CompositeVerifier
+        verifier = CompositeVerifier(StrictSerializabilityVerifier(),
+                                     ElleListAppendChecker())
         checked = 0
         for rec in self.results:
             reply = rec["reply"]

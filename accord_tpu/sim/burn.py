@@ -105,14 +105,18 @@ class BurnRun:
             self.nemesis = TopologyRandomizer(self.cluster, self.rng.fork(),
                                               period_s=topology_period_s)
             self.nemesis.start()
-        # two unrelated checking algorithms must both pass, like the
+        # three unrelated checking algorithms must all pass, like the
         # reference's own verifier composed with Elle (CompositeVerifier +
         # ElleVerifier.java:47): cycle detection on the constraint graph,
-        # and explicit witness construction + model replay
+        # explicit witness construction + model replay, and the ported
+        # Elle list-append analysis (sim/elle.py — version orders inferred
+        # from reads, SCC cycle search, anomaly classification)
+        from accord_tpu.sim.elle import ElleListAppendChecker
         from accord_tpu.sim.verify_replay import (CompositeVerifier,
                                                   WitnessReplayVerifier)
         self.verifier = CompositeVerifier(StrictSerializabilityVerifier(),
-                                          WitnessReplayVerifier())
+                                          WitnessReplayVerifier(),
+                                          ElleListAppendChecker())
         self.stats = BurnStats()
         self.next_value = 0
         self._value_owner: Dict[int, dict] = {}
