@@ -279,8 +279,12 @@ class LocalConfig:
     # RPC reply timeout = agent.pre_accept_timeout() * this
     rpc_timeout_multiplier: float = 10.0
     # recovery/invalidation futures are force-failed after
-    # rpc_timeout * this (see Node._arm_coordination_watchdog)
+    # rpc_timeout * this of INACTIVITY (the deadline re-arms on observable
+    # progress — replies received; see Node._arm_coordination_watchdog)
     coordination_watchdog_multiplier: float = 6.0
+    # ...but never live longer than watchdog_timeout * this overall, so a
+    # livelocked-but-chatty coordination still fails in bounded time
+    coordination_watchdog_hard_cap_multiplier: float = 10.0
     bootstrap_retry_delay_s: float = 1.0
     durability_shard_cycle_s: float = 30.0
     durability_global_cycle_every: int = 4

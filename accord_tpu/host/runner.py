@@ -217,10 +217,8 @@ class MaelstromRunner:
 
     def check_strict_serializability(self, n_keys: int) -> int:
         final = self.final_histories(n_keys)
-        from accord_tpu.sim.elle import ElleListAppendChecker
-        from accord_tpu.sim.verify_replay import CompositeVerifier
-        verifier = CompositeVerifier(StrictSerializabilityVerifier(),
-                                     ElleListAppendChecker())
+        from accord_tpu.sim.verify_replay import full_verifier
+        verifier = full_verifier(witness_replay=False)
         checked = 0
         for rec in self.results:
             reply = rec["reply"]

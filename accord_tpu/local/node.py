@@ -31,10 +31,12 @@ class _SafeCallback:
     """Once-only callback wrapper with timeout arming (reference
     SafeCallback + Node timeout registration)."""
 
-    def __init__(self, node: "Node", to: int, callback: Callback):
+    def __init__(self, node: "Node", to: int, callback: Callback,
+                 txn_id=None):
         self.node = node
         self.to = to
         self.callback = callback
+        self.txn_id = txn_id  # watched coordination to credit progress to
         self.done = False
         self.timer = None
 
@@ -58,6 +60,10 @@ class _SafeCallback:
         self.done = True
         if self.timer is not None:
             self.timer.cancel()
+        if self.txn_id is not None:
+            # any genuine reply (even a remote failure) is liveness
+            # evidence for the coordination's inactivity watchdog
+            self.node.note_coordination_progress(self.txn_id)
         try:
             if isinstance(reply, FailureReply):
                 self.callback.on_failure(self.to, reply.failure)
@@ -99,6 +105,15 @@ class Node:
         # when set, every has_side_effects request is recorded at processing
         self.journal = None
         self.coordinating: Dict[TxnId, AsyncResult] = {}
+        # txn_id -> last observable-progress time (s) for watched
+        # coordinations; see _arm_coordination_watchdog
+        self._coordination_activity: Dict[TxnId, float] = {}
+        # txn_id -> recovery rounds started here, pruned once the txn's
+        # local recovery future settles for good (storm-boundedness
+        # metric: watchdog-driven retry must not mask livelock;
+        # recovery_attempts_max keeps the high-water mark, burn-asserted)
+        self.recovery_attempts: Dict[TxnId, int] = {}
+        self.recovery_attempts_max = 0
         self._reply_seq = 0
         # epochs with a live shared refetch timer chain (_ensure_epoch_fetch)
         self._epoch_refetch: set = set()
@@ -275,6 +290,13 @@ class Node:
         self.coordinating[txn_id] = result
         result.add_callback(lambda v, f: self.coordinating.pop(txn_id, None))
         self._arm_coordination_watchdog(txn_id, result, "recovery")
+        n_attempts = self.recovery_attempts.get(txn_id, 0) + 1
+        self.recovery_attempts[txn_id] = n_attempts
+        self.recovery_attempts_max = max(self.recovery_attempts_max,
+                                         n_attempts)
+        result.add_callback(
+            lambda v, f: None if f is not None
+            else self.recovery_attempts.pop(txn_id, None))
         if self.trace.enabled:
             self.trace.event("recover", txn_id=txn_id)
         self.with_epoch(txn_id.epoch,
@@ -315,12 +337,45 @@ class Node:
         timeout_s = (self.agent.pre_accept_timeout()
                      * self.config.rpc_timeout_multiplier
                      * self.config.coordination_watchdog_multiplier)
-        timer = self.scheduler.once(
-            timeout_s,
-            lambda: result.try_failure(Timeout(
-                f"{what} of {txn_id} did not settle within {timeout_s:.1f}s "
-                f"(non-settling coordination path)")))
-        result.add_callback(lambda v, f: timer.cancel())
+        hard_s = timeout_s \
+            * self.config.coordination_watchdog_hard_cap_multiplier
+        start = self.now_us() / 1e6
+        self._coordination_activity[txn_id] = start
+        state = {}
+
+        def fire():
+            now = self.now_us() / 1e6
+            last = self._coordination_activity.get(txn_id, start)
+            if now - last < timeout_s and now - start < hard_s:
+                # observable progress since the last check (replies
+                # received, retries started): a slow-but-live coordination
+                # must not be force-failed (ADVICE r3) — re-arm for the
+                # remaining inactivity window, bounded by the hard cap
+                remaining = min(timeout_s - (now - last),
+                                hard_s - (now - start))
+                state["timer"] = self.scheduler.once(max(remaining, 1e-3),
+                                                     fire)
+                return
+            if now - start >= hard_s and now - last < timeout_s:
+                reason = (f"exceeded the {hard_s:.1f}s hard cap while still "
+                          f"exchanging messages (livelocked coordination)")
+            else:
+                reason = (f"saw no progress for {timeout_s:.1f}s "
+                          f"(non-settling coordination path)")
+            result.try_failure(Timeout(f"{what} of {txn_id} {reason}"))
+
+        state["timer"] = self.scheduler.once(timeout_s, fire)
+        result.add_callback(lambda v, f: (
+            state["timer"].cancel(),
+            self._coordination_activity.pop(txn_id, None)))
+
+    def note_coordination_progress(self, txn_id: TxnId) -> None:
+        """Record observable progress on a watched coordination so its
+        inactivity watchdog re-arms instead of firing (see
+        _arm_coordination_watchdog).  Called on every reply delivered to a
+        send carrying a coordinating txn's id."""
+        if txn_id in self._coordination_activity:
+            self._coordination_activity[txn_id] = self.now_us() / 1e6
 
     def with_epoch(self, epoch: int, fn: Callable[[], None]) -> None:
         """Run fn once `epoch` is locally known (Node.withEpoch)."""
@@ -360,9 +415,12 @@ class Node:
         with timeout (Node.send helpers :431-533)."""
         if isinstance(to_nodes, int):
             to_nodes = [to_nodes]
+        watched = getattr(request, "txn_id", None)
+        if watched is not None and watched not in self._coordination_activity:
+            watched = None
         for to in to_nodes:
             if callback is not None:
-                safe = _SafeCallback(self, to, callback)
+                safe = _SafeCallback(self, to, callback, txn_id=watched)
                 safe.arm_timeout(timeout_s if timeout_s is not None
                                  else self.agent.pre_accept_timeout()
                                  * self.config.rpc_timeout_multiplier)

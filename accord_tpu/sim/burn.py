@@ -111,12 +111,8 @@ class BurnRun:
         # explicit witness construction + model replay, and the ported
         # Elle list-append analysis (sim/elle.py — version orders inferred
         # from reads, SCC cycle search, anomaly classification)
-        from accord_tpu.sim.elle import ElleListAppendChecker
-        from accord_tpu.sim.verify_replay import (CompositeVerifier,
-                                                  WitnessReplayVerifier)
-        self.verifier = CompositeVerifier(StrictSerializabilityVerifier(),
-                                          WitnessReplayVerifier(),
-                                          ElleListAppendChecker())
+        from accord_tpu.sim.verify_replay import full_verifier
+        self.verifier = full_verifier()
         self.stats = BurnStats()
         self.next_value = 0
         self._value_owner: Dict[int, dict] = {}

@@ -166,3 +166,17 @@ class CompositeVerifier:
     def verify(self, final_histories: Dict[int, Sequence[int]]) -> None:
         for v in self.verifiers:
             v.verify(final_histories)
+
+
+def full_verifier(witness_replay: bool = True) -> CompositeVerifier:
+    """THE checker roster, in one place so no call site can drift to a
+    weaker oracle: constraint-graph cycle test, witness construction +
+    model replay (optional — the black-box host paths skip it), and the
+    ported Elle list-append analysis."""
+    from accord_tpu.sim.elle import ElleListAppendChecker
+    from accord_tpu.sim.verify import StrictSerializabilityVerifier
+    vs = [StrictSerializabilityVerifier()]
+    if witness_replay:
+        vs.append(WitnessReplayVerifier())
+    vs.append(ElleListAppendChecker())
+    return CompositeVerifier(*vs)

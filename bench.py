@@ -722,10 +722,8 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16):
                         final[int(t)] = tuple(v)
                     break
             req += 1
-        from accord_tpu.sim.elle import ElleListAppendChecker
-        from accord_tpu.sim.verify_replay import CompositeVerifier
-        verifier = CompositeVerifier(StrictSerializabilityVerifier(),
-                                     ElleListAppendChecker())
+        from accord_tpu.sim.verify_replay import full_verifier
+        verifier = full_verifier(witness_replay=False)
         for o in obs:
             verifier.observe(o)
         verifier.verify(final)  # raises on any anomaly
