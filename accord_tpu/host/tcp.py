@@ -235,6 +235,21 @@ class TcpHost:
 
     # ---------------------------------------------------------------- loop --
     def _run(self) -> None:
+        import os as _os
+        prof_path = _os.environ.get("ACCORD_TCP_PROFILE")
+        if not prof_path:
+            return self._run_loop()
+        # profile the node's single dispatch thread (where all protocol
+        # work happens; reader/writer threads only move bytes) — the
+        # BASELINE host-tier binding-constraint analysis reads these dumps
+        import cProfile
+        pr = cProfile.Profile()
+        try:
+            pr.runcall(self._run_loop)
+        finally:
+            pr.dump_stats(f"{prof_path}.{self.my_id}")
+
+    def _run_loop(self) -> None:
         while self.running:
             deadline = self.scheduler.next_deadline()
             timeout = (max(0.0, deadline - time.monotonic())
@@ -458,6 +473,9 @@ def main() -> None:
             time.sleep(0.05)
     finally:
         host.close()
+        # the loop is a daemon thread: give it a moment to finish its
+        # last dispatch (and flush the profiler dump when enabled)
+        host.loop_thread.join(timeout=5.0)
 
 
 if __name__ == "__main__":
