@@ -11,35 +11,65 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Set
 
-from accord_tpu.primitives.keys import Ranges
+from accord_tpu.primitives.keys import Ranges, Route
 from accord_tpu.topology.topologies import Topologies
 from accord_tpu.topology.topology import Topology
 from accord_tpu.utils import invariants
 from accord_tpu.utils.async_chains import AsyncResult, success
 
 
+def _covered_by(select, ranges: Ranges) -> bool:
+    """Is the selection (Route / Ranges / sorted key list) fully inside
+    `ranges`?  Used to decide per-range sync unlock."""
+    if isinstance(select, Route):
+        select = select.participants()
+    if isinstance(select, Ranges):
+        return ranges.contains_all_ranges(select)
+    return ranges.contains_all_keys(select)
+
+
 class EpochState:
-    __slots__ = ("global_topology", "synced_nodes", "sync_complete", "closed",
-                 "redundant")
+    __slots__ = ("global_topology", "synced_nodes", "sync_complete",
+                 "synced_ranges", "closed", "redundant")
 
     def __init__(self, global_topology: Topology):
         self.global_topology = global_topology
         self.synced_nodes: Set[int] = set()
         self.sync_complete = False
+        self.synced_ranges: Ranges = Ranges.EMPTY  # per-shard quorum-synced
         self.closed: Ranges = Ranges.EMPTY      # ranges no longer coordinated here
         self.redundant: Ranges = Ranges.EMPTY   # ranges fully superseded
 
     def recompute_sync(self) -> bool:
-        """Sync-complete when every shard has a (slow-path) quorum of synced
-        replicas (TopologyManager.onEpochSyncComplete quorum per shard)."""
+        """Accumulate quorum-synced shard ranges; sync-complete once every
+        shard has a (slow-path) quorum of synced replicas.
+
+        Per-range granularity mirrors the reference's curSyncComplete /
+        syncCompleteFor (TopologyManager.java:115-186): a shard whose quorum
+        has synced unlocks ITS range for precise coordination even while
+        other shards of the same epoch are still syncing."""
         if self.sync_complete:
             return True
+        synced = []
+        complete = True
         for shard in self.global_topology.shards:
             acks = sum(1 for n in shard.nodes if n in self.synced_nodes)
-            if acks < shard.slow_path_quorum_size:
-                return False
-        self.sync_complete = True
-        return True
+            if acks >= shard.slow_path_quorum_size:
+                synced.append(shard.range)
+            else:
+                complete = False
+        self.synced_ranges = Ranges(synced)
+        self.sync_complete = complete
+        return complete
+
+    def sync_complete_for(self, select) -> bool:
+        """Per-range unlock: the selection is fully inside quorum-synced
+        shard ranges (TopologyManager.java syncCompleteFor)."""
+        if self.sync_complete:
+            return True
+        if self.synced_ranges.is_empty:
+            return False
+        return _covered_by(select, self.synced_ranges)
 
 
 class TopologyManager:
@@ -52,6 +82,10 @@ class TopologyManager:
         self._max_epoch = 0
         self._pending: Dict[int, AsyncResult] = {}
         self._fetch_hook: Optional[Callable[[int], None]] = None
+        # windows: with_unsynced_epochs calls; extended: windows widened to
+        # older epochs; range_unlocks: windows kept precise by the per-range
+        # sync test while the epoch as a whole was still syncing
+        self.stats = {"windows": 0, "extended": 0, "range_unlocks": 0}
 
     # -- feeding --
     def on_topology_update(self, topology: Topology) -> None:
@@ -130,6 +164,13 @@ class TopologyManager:
         state = self._epochs.get(epoch)
         return state is not None and state.sync_complete
 
+    def sync_complete_for(self, epoch: int, select) -> bool:
+        """Epoch-sync test at range granularity: true when the selection's
+        ranges all belong to quorum-synced shards of `epoch`, even if the
+        epoch as a whole is still syncing (TopologyManager.syncCompleteFor)."""
+        state = self._epochs.get(epoch)
+        return state is not None and state.sync_complete_for(select)
+
     def await_epoch(self, epoch: int) -> AsyncResult:
         """Resolves (with the Topology) once `epoch` is known locally."""
         if epoch in self._epochs:
@@ -156,11 +197,28 @@ class TopologyManager:
     def with_unsynced_epochs(self, select, min_epoch: int, max_epoch: int
                              ) -> Topologies:
         """[min_epoch, max_epoch] extended downward through epochs whose sync
-        has not yet quorum-completed, so replicas still serving old epochs are
-        contacted (TopologyManager.withUnsyncedEpochs)."""
+        has not yet quorum-completed FOR THE SELECTION's ranges, so replicas
+        still serving old epochs are contacted
+        (TopologyManager.withUnsyncedEpochs).  Range-granular: an epoch
+        counts as synced when every shard range the selection touches has a
+        sync quorum, even while other shards of that epoch are still syncing
+        (reference syncCompleteFor, TopologyManager.java:115-186)."""
+        self.stats["windows"] += 1
         lo = min_epoch
-        while lo > self._min_epoch and not self.is_sync_complete(lo):
+        range_unlock = False
+        while True:
+            state = self._epochs.get(lo)
+            if state is not None and state.sync_complete_for(select):
+                range_unlock = not state.sync_complete
+                break
+            if lo <= self._min_epoch:
+                break
             lo -= 1
+        if lo < min_epoch:
+            self.stats["extended"] += 1
+        elif range_unlock:
+            # only a PRECISE window counts as a per-range unlock win
+            self.stats["range_unlocks"] += 1
         out: List[Topology] = []
         for e in range(max_epoch, lo - 1, -1):
             out.append(self.for_epoch(e).for_selection(select))

@@ -208,6 +208,38 @@ class TestTopologyManager:
         p = tm.precise_epochs(sel, 1, 2)
         assert (p.oldest_epoch, p.current_epoch) == (1, 2)
 
+    def test_per_range_sync_unlock(self):
+        """A shard whose quorum has synced unlocks ITS range for precise
+        coordination while the other shard is still syncing (reference
+        TopologyManager.java:115-186 syncCompleteFor)."""
+        def split_topo(epoch):
+            return Topology(epoch, [Shard(Range(0, 50), [1, 2, 3]),
+                                    Shard(Range(50, 100), [4, 5, 6])])
+        tm = TopologyManager(node_id=1)
+        tm.on_topology_update(split_topo(1))
+        tm.on_topology_update(split_topo(2))
+        # only shard A's replicas report sync for epoch 2
+        tm.on_epoch_sync_complete(1, 2)
+        tm.on_epoch_sync_complete(2, 2)
+        assert not tm.is_sync_complete(2)  # epoch as a whole still syncing
+        sel_a, sel_b = Keys.of(10), Keys.of(60)
+        assert tm.sync_complete_for(2, sel_a)
+        assert not tm.sync_complete_for(2, sel_b)
+        # coordination on shard A's range proceeds precisely on epoch 2...
+        wa = tm.with_unsynced_epochs(sel_a, 2, 2)
+        assert (wa.oldest_epoch, wa.current_epoch) == (2, 2)
+        # ...while shard B's range still extends the window to epoch 1
+        wb = tm.with_unsynced_epochs(sel_b, 2, 2)
+        assert (wb.oldest_epoch, wb.current_epoch) == (1, 2)
+        # range-domain and Route selections get the same answer
+        assert tm.sync_complete_for(2, Ranges.of((0, 40)))
+        assert not tm.sync_complete_for(2, Ranges.of((40, 70)))
+        # shard B quorum completes -> epoch fully synced
+        tm.on_epoch_sync_complete(4, 2)
+        tm.on_epoch_sync_complete(5, 2)
+        assert tm.is_sync_complete(2)
+        assert tm.sync_complete_for(2, sel_b)
+
     def test_out_of_order_epoch_rejected(self):
         tm = TopologyManager(node_id=1)
         tm.on_topology_update(topo(epoch=1))

@@ -113,6 +113,52 @@ class TestMembershipChange:
         assert cluster.node(leave).data_store.get(Key(5)) in ((), (0,))
 
 
+class TestPerRangeSyncUnlock:
+    def test_synced_range_coordinates_precisely_while_other_shard_pending(self):
+        """With shard B's sync gossip suppressed, coordination on shard A's
+        range must proceed on the new epoch with a PRECISE window (no
+        extension to the old epoch), while shard B's range still extends —
+        the reference's per-range syncCompleteFor behavior
+        (TopologyManager.java:115-186)."""
+        from accord_tpu.messages.epoch import EpochSyncComplete
+        cluster = SimCluster(n_nodes=6, seed=66, n_shards=2, rf=3)
+        span = cluster.token_span
+        old_a = cluster.topology.shards[0]
+        run_txn(cluster, 1, rw_txn([], {old_a.range.start + 1: 0}))
+        cluster.process_all()
+
+        # epoch 2: DISJOINT replica sets — A keeps (1,2,3); B moves to (4,5,6)
+        shard_a = Shard(Range(0, span // 2), [1, 2, 3])
+        shard_b = Shard(Range(span // 2, span), [4, 5, 6])
+
+        # suppress sync acks FROM shard B's replicas for the new epoch, so
+        # shard B never reaches its sync quorum anywhere
+        def drop_b_sync(from_id, to_id, message):
+            return (isinstance(message, EpochSyncComplete)
+                    and message.epoch == 2 and from_id in shard_b.nodes)
+        cluster.network.add_filter(drop_b_sync)
+        cluster.update_topology(Topology(2, [shard_a, shard_b]))
+        cluster.process_all()
+
+        coordinator = cluster.node(1)
+        tm = coordinator.topology
+        # node 1 is not a shard-B replica, so B's dropped acks can never be
+        # offset by a local self-ack on this node
+        assert 1 not in shard_b.nodes
+        assert not tm.is_sync_complete(2), \
+            "test setup: epoch 2 must not fully sync"
+        before = dict(tm.stats)
+        token_a = shard_a.range.start + 2
+        run_txn(cluster, 1, rw_txn([], {token_a: 1}))
+        assert tm.stats["range_unlocks"] > before["range_unlocks"], \
+            "coordination on synced shard A should take the per-range unlock"
+        # a txn on shard B's range still widens the window to epoch 1
+        before = dict(tm.stats)
+        run_txn(cluster, 1, rw_txn([], {shard_b.range.start + 2: 1}))
+        assert tm.stats["extended"] > before["extended"], \
+            "coordination on unsynced shard B should extend the window"
+
+
 class TestSplitMergeFastpath:
     def test_split_preserves_operation(self):
         cluster = SimCluster(n_nodes=3, seed=65, n_shards=1)
