@@ -38,6 +38,10 @@ class _CheckShards(Callback):
         self.merged: Optional[CheckStatusOk] = None
         self.tracker: Optional[QuorumTracker] = None
         self.done = False
+        # Infer-narrowing price counters: which contacted replicas attached
+        # durability-derived invalid-if-undecided evidence
+        self._contacted = 0
+        self._evidence_replies = 0
 
     def start(self) -> None:
         topologies = self.node.topology.with_unsynced_epochs(
@@ -48,6 +52,7 @@ class _CheckShards(Callback):
             scope = TxnRequest.compute_scope(to, topologies, self.route)
             if scope is None:
                 continue
+            self._contacted += 1
             self.node.send(to, CheckStatus(self.txn_id, scope,
                                            self.include_info),
                            callback=self)
@@ -56,10 +61,20 @@ class _CheckShards(Callback):
         if self.done:
             return
         if isinstance(reply, CheckStatusOk):
+            if reply.invalid_if_undecided:
+                self._evidence_replies += 1
             self.merged = (reply if self.merged is None
                            else self.merged.merge(reply))
         if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
             self.done = True
+            if self._evidence_replies:
+                stats = self.node.infer_stats
+                stats["evidence"] += 1
+                # majority-of-contacted proxy for the reference's per-shard
+                # quorum test (Infer.inferInvalidWithQuorum): these are the
+                # interrogations the reference resolves with NO extra round
+                if self._evidence_replies * 2 > self._contacted:
+                    stats["quorum_evidence"] += 1
             self.result.try_success(self.merged)
 
     def on_failure(self, from_id: int, failure: BaseException) -> None:
@@ -242,6 +257,11 @@ def maybe_recover(node, txn_id: TxnId, route: Route,
         # settle any race with a live recovery)
         inferred_invalid = (undecided and merged is not None
                             and merged.invalid_if_undecided)
+        if inferred_invalid:
+            # the price of the documented Infer narrowing: a full
+            # ballot-protected Invalidate round where the reference's
+            # inferInvalidWithQuorum may commit-invalidate with none
+            node.infer_stats["inferred_rounds"] += 1
         chase = (node.invalidate
                  if undecided and (inferred_invalid or not best.is_full)
                  else node.recover)

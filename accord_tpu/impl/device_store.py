@@ -113,24 +113,42 @@ class DeviceSafeCommandStore(SafeCommandStore):
                           exclude: Optional[TxnId] = None) -> None:
         store: DeviceCommandStore = self.store
         probe = store._precomputed.get((before, kinds))
-        if probe is None or isinstance(participants, Ranges):
+        is_range = isinstance(participants, Ranges)
+        owned = self._owned_participants(participants)
+        # range-domain participants: the per-key tier is the CFK walk over
+        # keys inside the ranges — the probe precomputed exactly that set
+        # at snapshot time (see _collect_deps_probes); any key born since
+        # fails the cover check below and falls back to scalar
+        keys = self._owned_cfk_keys(owned) if is_range else owned
+        if probe is None:
+            if len(keys) == 0:
+                # nothing in the per-key tier to scan (the collection skips
+                # empty-owned probes for the same reason): served trivially,
+                # only the range-conflict arm remains
+                store.device_hits += 1
+                self._map_range_conflicts(owned, is_range, before, kinds,
+                                          fn, on_range_dep)
+                return
             store.device_misses += 1
+            store.device_miss_causes["no_probe"] += 1
             return super().map_reduce_active(participants, before, kinds, fn,
                                              on_range_dep, exclude)
-        owned = self._owned_participants(participants)
         if not all(k in probe.key_set and self._version_ok(k, probe, exclude)
-                   for k in owned):
+                   for k in keys):
             store.device_misses += 1
+            store.device_miss_causes[
+                "version" if all(k in probe.key_set for k in keys)
+                else "key_cover"] += 1
             return super().map_reduce_active(participants, before, kinds, fn,
                                              on_range_dep, exclude)
         store.device_hits += 1
         if store.verify:
-            self._verify_against_scalar(owned, before, kinds, exclude, probe)
-        for key in owned:
+            self._verify_against_scalar(keys, before, kinds, exclude, probe)
+        for key in keys:
             for dep in probe.keyed.get(key, ()):
                 if dep != exclude:
                     fn(key, dep)
-        self._map_range_conflicts(owned, False, before, kinds, fn,
+        self._map_range_conflicts(owned, is_range, before, kinds, fn,
                                   on_range_dep)
 
     # ------------------------------------------------- range-conflict arm --
@@ -376,10 +394,12 @@ class DeviceCommandStore(CommandStore):
     """
 
     def __init__(self, store_id: int, node, ranges, *,
-                 flush_window_us: int = 0, verify: bool = False):
+                 flush_window_us: int = 0, verify: bool = False,
+                 plan_waves: bool = True):
         super().__init__(store_id, node, ranges)
         self.flush_window_us = flush_window_us
         self.verify = verify
+        self.plan_waves = plan_waves  # A/B toggle (measure_device.py)
         self._window: List[Tuple[PreLoadContext, object, object]] = []
         self._flush_scheduled = False
         self._precomputed: Dict[Tuple[Timestamp, KindSet], _Probe] = {}
@@ -391,6 +411,11 @@ class DeviceCommandStore(CommandStore):
         self._range_index_cache = None
         self.device_hits = 0
         self.device_misses = 0
+        # miss-cause breakdown for the deps arm (hit-rate diagnosis):
+        # no_probe (nothing precomputed at this (before, kinds)), version
+        # (gate tripped), key_cover (probe didn't cover a queried key)
+        self.device_miss_causes = {"no_probe": 0, "version": 0,
+                                   "key_cover": 0}
         self.device_batches = 0
         self.device_batched_probes = 0
         self.device_max_batch = 0
@@ -410,10 +435,12 @@ class DeviceCommandStore(CommandStore):
         self.device_disabled = False
 
     @classmethod
-    def factory(cls, flush_window_us: int = 0, verify: bool = False):
+    def factory(cls, flush_window_us: int = 0, verify: bool = False,
+                plan_waves: bool = True):
         return lambda i, node, ranges: cls(i, node, ranges,
                                            flush_window_us=flush_window_us,
-                                           verify=verify)
+                                           verify=verify,
+                                           plan_waves=plan_waves)
 
     def _make_safe(self, context: PreLoadContext) -> SafeCommandStore:
         return DeviceSafeCommandStore(self, context)
@@ -444,7 +471,8 @@ class DeviceCommandStore(CommandStore):
                 self._precompute(window)
                 self._precompute_recovery(window)
                 self._precompute_ranges(window)
-                plan = self._plan_waves(window)
+                if self.plan_waves:
+                    plan = self._plan_waves(window)
             except Exception as exc:  # noqa: BLE001 — mid-run backend death
                 if self.verify:
                     # equivalence-certification mode must not silently
@@ -478,10 +506,23 @@ class DeviceCommandStore(CommandStore):
         seen: Set[Tuple[Timestamp, KindSet]] = set()
         for context, _fn, _result in window:
             for before, kinds, keys in context.deps_probes:
-                if (before, kinds) in seen or isinstance(keys, Ranges):
-                    continue  # range-domain probes go to the stab tier
-                owned = keys.slice(self.ranges) if not self.ranges.is_empty \
-                    else keys
+                if (before, kinds) in seen:
+                    continue
+                if isinstance(keys, Ranges):
+                    # range-domain probe (sync point / range txn): its
+                    # per-key tier is the CFK walk over the keys inside the
+                    # ranges — materialize that key set at snapshot time so
+                    # the kernel precomputes it like any key probe (the
+                    # geometric range-command arm still goes to the stab
+                    # tier).  A key born after this snapshot fails the
+                    # serve-time cover check and falls back to scalar.
+                    owned_r = keys.intersection(self.ranges) \
+                        if not self.ranges.is_empty else keys
+                    owned = sorted(k for k in self.cfks
+                                   if owned_r.contains(k))
+                else:
+                    owned = keys.slice(self.ranges) \
+                        if not self.ranges.is_empty else keys
                 if len(owned) == 0:
                     continue
                 seen.add((before, kinds))
@@ -822,17 +863,7 @@ class MeshDeviceCommandStore(DeviceCommandStore):
         creates (a per-store shard_map closure would recompile per store).
         With no mesh and a single-device backend, stores run the parent's
         single-chip path."""
-        import jax
-
-        if mesh is None and len(jax.devices()) > 1:
-            from jax.sharding import Mesh
-            mesh = Mesh(np.array(jax.devices()), ("shard",))
-        step = None
-        n_shards = 0
-        if mesh is not None:
-            from accord_tpu.ops.sharded import make_sharded_deps_step
-            step = make_sharded_deps_step(mesh)
-            n_shards = mesh.devices.size
+        mesh, step, n_shards = _mesh_step_setup(mesh)
         return lambda i, node, ranges: cls(
             i, node, ranges, flush_window_us=flush_window_us, verify=verify,
             mesh=mesh, sharded_step=step, n_shards=n_shards)
@@ -858,3 +889,21 @@ class MeshDeviceCommandStore(DeviceCommandStore):
             *args[:5], args[5], args[6], args[8])
         keyed = enc.decode_key_deps(np.asarray(dep_mask))
         self._install_probes(probes, keyed, versions, committed_versions)
+
+
+def _mesh_step_setup(mesh):
+    """Shared mesh + compiled SPMD step for a mesh-store factory: build the
+    mesh from the visible devices when none is given (single-device backends
+    get none, degrading stores to the single-chip path)."""
+    import jax
+
+    if mesh is None and len(jax.devices()) > 1:
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()), ("shard",))
+    step = None
+    n_shards = 0
+    if mesh is not None:
+        from accord_tpu.ops.sharded import make_sharded_deps_step
+        step = make_sharded_deps_step(mesh)
+        n_shards = mesh.devices.size
+    return mesh, step, n_shards

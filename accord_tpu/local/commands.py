@@ -28,6 +28,16 @@ from accord_tpu.primitives.txn import PartialTxn
 from accord_tpu.primitives.writes import Writes
 from accord_tpu.utils import invariants
 
+# Scalar-walk work counters (the Commands.java:656,1011 walk the device
+# wavefront planner aims to displace): incremented process-wide, reset by
+# measurement harnesses per run (measure_device.py A/B evidence).
+WORK = {"maybe_execute": 0, "notify": 0}
+
+
+def reset_work_counters() -> None:
+    WORK["maybe_execute"] = 0
+    WORK["notify"] = 0
+
 
 class AcceptOutcome(enum.Enum):
     SUCCESS = "SUCCESS"
@@ -248,8 +258,21 @@ def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId,
         return AcceptOutcome.REDUNDANT
     cmd.set_promised(ballot)
     cmd.accepted_ballot = ballot
-    if cmd.save_status < SaveStatus.ACCEPTED_INVALIDATE:
-        cmd.set_status(SaveStatus.ACCEPTED_INVALIDATE)
+    # UNCONDITIONALLY supersede any prior accepted value with the
+    # invalidate acceptance (reference Command.acceptInvalidated:1698 sets
+    # Status.AcceptedInvalidate regardless of a prior Accepted — their
+    # accepted register now holds "invalidate" at this ballot, executeAt /
+    # definition retained).  The old `if save_status <
+    # ACCEPTED_INVALIDATE` guard kept an ACCEPTED status while bumping
+    # accepted_ballot, fabricating "original value accepted at this
+    # ballot": a later recovery then preferred the stale value over the
+    # invalidate accepted at the same ballot and re-proposed a txn an
+    # invalidation had already decided against — a committed-vs-invalidated
+    # divergence (soak seed 57012, triage_57012.py).  Direct assignment:
+    # this is the one legal non-cleanup status "regression" (set_status
+    # guards it), mirroring the reference's modelling of AcceptedInvalidate
+    # as a fresh acceptance rather than a phase advance.
+    cmd.save_status = SaveStatus.ACCEPTED_INVALIDATE
     return AcceptOutcome.SUCCESS
 
 
@@ -643,6 +666,7 @@ def maybe_execute(safe_store: SafeCommandStore, cmd: Command,
                   always_notify: bool) -> bool:
     """Advance Stable->ReadyToExecute->apply when the WaitingOn set clears
     (Commands.maybeExecute :656)."""
+    WORK["maybe_execute"] += 1
     if cmd.save_status not in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
         if always_notify:
             _notify_listeners(safe_store, cmd)
@@ -729,6 +753,7 @@ def _enqueue_notify(safe_store: SafeCommandStore, item) -> None:
     drain queue so arbitrarily deep apply cascades use constant stack (the
     reference's NotifyWaitingOn walker, Commands.java:1011, achieves the
     same by running each step as a separate executor task)."""
+    WORK["notify"] += 1
     store = safe_store.store
     store.notify_queue.append(item)
     if store.notifying:

@@ -114,6 +114,14 @@ class Node:
         # recovery_attempts_max keeps the high-water mark, burn-asserted)
         self.recovery_attempts: Dict[TxnId, int] = {}
         self.recovery_attempts_max = 0
+        # pricing the Infer narrowing (coordinate/infer.py vs reference
+        # Infer.inferInvalidWithQuorum): evidence = CheckStatus merges whose
+        # replies carried invalid-if-undecided; quorum_evidence = merges
+        # where a MAJORITY of contacted replicas carried it (the cases the
+        # reference invalidates with ZERO extra rounds); inferred_rounds =
+        # ballot-protected Invalidate rounds we launched on that evidence
+        self.infer_stats = {"evidence": 0, "quorum_evidence": 0,
+                            "inferred_rounds": 0}
         self._reply_seq = 0
         # epochs with a live shared refetch timer chain (_ensure_epoch_fetch)
         self._epoch_refetch: set = set()

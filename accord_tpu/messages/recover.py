@@ -192,6 +192,18 @@ class BeginRecovery(TxnRequest):
             return (self.txn_id, self.scope.participant_keys())
         return None  # range-domain recovery: the key tier has no probe
 
+    def deps_probe(self):
+        # apply() also contributes a fresh local deps calculation when no
+        # committed deps are held (calculate_deps at before=txn_id); declare
+        # it so the device window precomputes it alongside the recovery
+        # predicates.  The serve-time key set (_local_keys, state-dependent)
+        # must be covered by this declaration or the scan falls back to the
+        # scalar walk — which the cover/version gates enforce.
+        keys = (self.partial_txn.keys if self.partial_txn is not None
+                else (self.scope.participant_keys()
+                      if self.scope.is_key_domain else self.scope.ranges))
+        return (self.txn_id, self.txn_id.kind.witnesses(), keys)
+
     def reduce(self, a: Reply, b: Reply) -> Reply:
         if isinstance(a, RecoverNack):
             return a

@@ -57,7 +57,8 @@ class BurnRun:
                  drop_prob: float = 0.0, rf: int = None, n_shards: int = 4,
                  concurrency: int = 8,
                  progress_log_factory="default", num_command_stores: int = 1,
-                 range_reads: bool = True, durability: bool = True,
+                 range_reads: bool = True, range_every: int = 8,
+                 durability: bool = True,
                  durability_cycle_s: float = None,
                  topology_changes: bool = True,
                  topology_period_s: float = 3.0,
@@ -94,6 +95,7 @@ class BurnRun:
         self.keys = keys
         self.concurrency = concurrency
         self.range_reads = range_reads
+        self.range_every = range_every
         if durability:
             # randomized cadence like the reference burn (Cluster.java:333)
             cycle = (durability_cycle_s if durability_cycle_s is not None
@@ -120,9 +122,9 @@ class BurnRun:
     # ---------------------------------------------------------- workload --
     def _gen_txn(self) -> Txn:
         rng = self.rng
-        # ~1 in 8 ops: a range read over a token window (the reference burn
-        # mixes range queries into the workload, BurnTest.java:124-210)
-        if self.range_reads and rng.next_int(0, 8) == 0:
+        # ~1 in range_every ops: a range read over a token window (the
+        # reference burn mixes range queries in, BurnTest.java:124-210)
+        if self.range_reads and rng.next_int(0, self.range_every) == 0:
             lo = rng.next_int(0, self.keys - 1)
             hi = min(self.keys, lo + 1 + rng.next_int(1, max(2, self.keys // 4)))
             ranges = Ranges.of((lo, hi))
@@ -303,18 +305,27 @@ def main(argv=None) -> int:
     parser.add_argument("--device-store", action="store_true",
                         help="run deps scans on the batched device tier "
                              "(flush-window accumulation -> one kernel call)")
+    parser.add_argument("--mesh-store", action="store_true",
+                        help="device tier with the mesh-sharded SPMD deps "
+                             "step (MeshDeviceCommandStore; needs >1 jax "
+                             "device, e.g. xla_force_host_platform_"
+                             "device_count)")
     parser.add_argument("--device-verify", action="store_true",
                         help="cross-check every device-served scan against "
                              "the scalar oracle inline")
-    parser.add_argument("--flush-window-us", type=int, default=200,
-                        help="device-store flush window (virtual us)")
+    parser.add_argument("--flush-window-us", type=int, default=300,
+                        help="device-store flush window (virtual us; 300 "
+                             "measured best — see BASELINE.md latency-tax "
+                             "table)")
+    parser.add_argument("--range-heavy", action="store_true",
+                        help="range reads ~1 in 3 ops instead of 1 in 8")
     parser.add_argument("--message-stats", action="store_true",
                         help="print per-message-type delivery/drop counters")
     parser.add_argument("--trace", action="store_true",
                         help="record structured protocol events per node and "
                              "print the tail after the run")
     args = parser.parse_args(argv)
-    if args.device_store:
+    if args.device_store or args.mesh_store:
         # the device store initialises jax: probe the (possibly
         # dead-tunneled) TPU backend with a timeout first, falling back to
         # CPU, or the CLI blocks forever on backend resolution
@@ -326,7 +337,20 @@ def main(argv=None) -> int:
         # its state across --loops iterations, making a failure at loop
         # seed N irreproducible by `-s N` alone (burn soaks found exactly
         # that: a seed-15003 violation that vanished standalone)
-        if args.device_store:
+        if args.device_store or args.mesh_store:
+            if args.delayed_stores:
+                # delayed-executor nemesis composed OVER the device tier
+                from accord_tpu.sim.delayed_store import delayed_device_factory
+                from accord_tpu.utils.random_source import RandomSource
+                return delayed_device_factory(
+                    RandomSource(seed ^ 0x5D5D), mesh_store=args.mesh_store,
+                    flush_window_us=args.flush_window_us,
+                    verify=args.device_verify)
+            if args.mesh_store:
+                from accord_tpu.impl.device_store import MeshDeviceCommandStore
+                return MeshDeviceCommandStore.factory(
+                    flush_window_us=args.flush_window_us,
+                    verify=args.device_verify)
             from accord_tpu.impl.device_store import DeviceCommandStore
             return DeviceCommandStore.factory(
                 flush_window_us=args.flush_window_us,
@@ -341,7 +365,7 @@ def main(argv=None) -> int:
         seed = args.seed + i
         store_factory = make_store_factory(seed)
         run = BurnRun(seed, args.ops, nodes=args.nodes, keys=args.keys,
-                      rf=args.rf,
+                      rf=args.rf, range_every=3 if args.range_heavy else 8,
                       n_shards=args.shards, drop_prob=args.drop,
                       store_factory=store_factory,
                       num_command_stores=args.stores,
@@ -354,7 +378,7 @@ def main(argv=None) -> int:
                 if dump:
                     print(dump)
         extra = ""
-        if args.device_store:
+        if args.device_store or args.mesh_store:
             h = m = b = p = rh = rm = dis = 0
             wb = wp = wx = wd = gh = gm = 0
             mx = 0
@@ -381,6 +405,19 @@ def main(argv=None) -> int:
                      f"wave_executed={wx} wave_depth={wd} "
                      f"range_hits={gh} range_misses={gm}"
                      + (f" DISABLED={dis}" if dis else "") + "]")
+        inf = {"evidence": 0, "quorum_evidence": 0, "inferred_rounds": 0}
+        for node in run.cluster.nodes.values():
+            for k in inf:
+                inf[k] += node.infer_stats[k]
+        if any(inf.values()):
+            # pricing the Infer narrowing (VERDICT r4 #8): quorum_evidence
+            # counts interrogations the reference's inferInvalidWithQuorum
+            # would settle with no extra round; inferred_rounds is what we
+            # actually paid in ballot-protected Invalidate rounds
+            extra += (f" infer[evidence={inf['evidence']} "
+                      f"quorum_evidence={inf['quorum_evidence']} "
+                      f"inferred_rounds={inf['inferred_rounds']}]")
+
         def lat(pct):
             us = stats.latency_us(pct)
             return f"{us / 1e3:.1f}ms" if us >= 0 else "n/a"

@@ -29,8 +29,11 @@ class DelayedCommandStore(CommandStore):
     def __init__(self, store_id: int, node, ranges, *,
                  random: RandomSource,
                  min_delay_us: int = 50, max_delay_us: int = 2_000,
-                 miss_prob: float = 0.2, miss_delay_us: int = 5_000):
-        super().__init__(store_id, node, ranges)
+                 miss_prob: float = 0.2, miss_delay_us: int = 5_000,
+                 **base_kw):
+        # **base_kw flows to the next class in the MRO so the delay nemesis
+        # composes over richer store tiers (device/mesh flush-window stores)
+        super().__init__(store_id, node, ranges, **base_kw)
         self.random = random
         self.min_delay_us = min_delay_us
         self.max_delay_us = max_delay_us
@@ -76,3 +79,41 @@ class DelayedCommandStore(CommandStore):
                 self._schedule_next()
             else:
                 self._draining = False
+
+
+def _device_bases():
+    # lazy: pulls numpy/jax-adjacent modules only when a device-tier burn
+    # actually asks for the composition
+    from accord_tpu.impl.device_store import (DeviceCommandStore,
+                                              MeshDeviceCommandStore,
+                                              _mesh_step_setup)
+    return DeviceCommandStore, MeshDeviceCommandStore, _mesh_step_setup
+
+
+def delayed_device_factory(random: RandomSource, *, mesh_store: bool = False,
+                           flush_window_us: int = 0, verify: bool = False):
+    """Store factory composing the delayed-executor nemesis over the batched
+    device tier (reference analogue: DelayedCommandStores.java:61-175
+    wrapping the real store): tasks queue on the simulated delayed executor
+    with randomized delays + cache-miss page-ins, then drain into the device
+    store's flush window, exercising the batch path under storage-latency
+    chaos.  `mesh_store` selects the mesh-sharded SPMD tier."""
+    DeviceCommandStore, MeshDeviceCommandStore, _mesh_step_setup = \
+        _device_bases()
+
+    class DelayedDeviceCommandStore(DelayedCommandStore, DeviceCommandStore):
+        pass
+
+    class DelayedMeshDeviceCommandStore(DelayedCommandStore,
+                                        MeshDeviceCommandStore):
+        pass
+
+    if mesh_store:
+        mesh, step, n_shards = _mesh_step_setup(None)
+        return lambda i, node, ranges: DelayedMeshDeviceCommandStore(
+            i, node, ranges, random=random.fork(),
+            flush_window_us=flush_window_us, verify=verify,
+            mesh=mesh, sharded_step=step, n_shards=n_shards)
+    return lambda i, node, ranges: DelayedDeviceCommandStore(
+        i, node, ranges, random=random.fork(),
+        flush_window_us=flush_window_us, verify=verify)

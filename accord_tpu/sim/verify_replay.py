@@ -167,6 +167,12 @@ class CompositeVerifier:
         for v in self.verifiers:
             v.verify(final_histories)
 
+    @property
+    def observations(self):
+        """The shared observation stream (every member sees the same one);
+        exported by the external-Elle harness (sim/elle_export.py)."""
+        return self.verifiers[0].observations
+
 
 def full_verifier(witness_replay: bool = True) -> CompositeVerifier:
     """THE checker roster, in one place so no call site can drift to a

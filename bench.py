@@ -40,13 +40,68 @@ import numpy as np
 
 PLATFORM = "unprobed"  # set by main() for device-using configs
 JSON_OUT = None        # optional path: emit() mirrors the JSON line there
+CONFIG = "default"     # set by main(); keys the regression-guard history
 ROWS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_DEVICE_ROWS.json")
+HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_HISTORY.json")
+
+
+def _platform_class(platform: str) -> str:
+    return "cpu" if platform.startswith("cpu") else "device"
+
+
+# configs whose metric is a time (lower is better); everything else is a
+# throughput (higher is better)
+LOWER_IS_BETTER = {"tpcc"}
+
+
+def _regression_guard(result: dict) -> None:
+    """Annotate the result with the last same-platform-class number for this
+    config and flag regressions >10% — BENCH_r04's CPU number silently
+    regressed 8% vs r03 with nobody noticing; never again.  Annotation, not
+    assertion: the driver must still get its JSON line.  Host-tier configs
+    (maelstrom/tcp) carry no platform field and are classed "host" — their
+    wall-clock numbers are load-sensitive, so the annotation is a prompt to
+    investigate, not proof of a code regression."""
+    try:
+        value = result.get("value")
+        if not isinstance(value, (int, float)):
+            return
+        pclass = _platform_class(result["platform"]) \
+            if result.get("platform") else "host"
+        try:
+            with open(HISTORY_PATH) as f:
+                history = json.load(f)
+        except (OSError, ValueError):
+            history = {}
+        prev = history.get(CONFIG, {}).get(pclass)
+        if prev and prev.get("value"):
+            result["prev_same_platform"] = prev
+            pct = (value - prev["value"]) / prev["value"] * 100.0
+            if CONFIG in LOWER_IS_BETTER:
+                pct = -pct
+            if pct < -10.0:
+                result["REGRESSION_vs_prev_pct"] = round(pct, 1)
+        history.setdefault(CONFIG, {})[pclass] = {
+            "value": value, "platform": result.get("platform", "host"),
+            "unix": int(time.time())}
+        # pid-unique tmp: the --fill loop and interactive runs may emit
+        # concurrently; a shared tmp path could interleave truncated JSON
+        tmp = f"{HISTORY_PATH}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(history, f, indent=1)
+        os.replace(tmp, HISTORY_PATH)
+    except OSError:
+        # best-effort annotation: a read-only checkout or full disk must
+        # never cost the driver its one-line JSON contract
+        pass
 
 
 def emit(result: dict) -> None:
     """Print the one-line JSON contract; mirror to --json-out if set (the
     --fill orchestrator reads it back from the subprocess)."""
+    _regression_guard(result)
     line = json.dumps(result)
     print(line)
     if JSON_OUT:
@@ -1085,7 +1140,7 @@ def fill_device_rows(max_wait_s: float, only=None) -> int:
 
 
 def main():
-    global PLATFORM, JSON_OUT
+    global PLATFORM, JSON_OUT, CONFIG
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="default",
                     choices=["default", "zipf1m", "rangestress", "tpcc",
@@ -1105,6 +1160,7 @@ def main():
                     help="--fill: comma-separated subset of configs")
     ns = ap.parse_args()
     JSON_OUT = ns.json_out
+    CONFIG = ns.config
     if ns.fill:
         only = set(ns.only.split(",")) if ns.only else None
         missing = fill_device_rows(ns.max_wait, only)

@@ -92,6 +92,57 @@ def test_burn_hostile_device_store():
     assert hits > 0
 
 
+def test_burn_hostile_device_store_contended_heavy_loss():
+    """Device store under 25% loss x partitions x drift x 4 stores x 6-key
+    contention — the combination VERDICT r4 flagged as blind (rounds 2-3
+    found their worst bugs in device-store x loss x churn x multi-store
+    geometry). verify=True certifies every served scan against the scalar
+    oracle through the whole hostile run."""
+    from accord_tpu.impl.device_store import DeviceCommandStore
+    run = BurnRun(57011, 60, drop_prob=0.25, partitions=True,
+                  clock_drift=True, keys=6, num_command_stores=4,
+                  store_factory=DeviceCommandStore.factory(
+                      flush_window_us=300, verify=True))
+    stats = run.run()
+    assert stats.lost == 0 and stats.pending == 0
+
+
+def test_burn_hostile_mesh_store_under_loss():
+    """Mesh-sharded SPMD store (8-device virtual mesh via conftest) under
+    message loss + partitions; previously only ever exercised loss-free."""
+    from accord_tpu.impl.device_store import MeshDeviceCommandStore
+    run = BurnRun(54008, 60, drop_prob=0.15, partitions=True,
+                  num_command_stores=2,
+                  store_factory=MeshDeviceCommandStore.factory(
+                      flush_window_us=300, verify=True))
+    stats = run.run()
+    assert stats.lost == 0 and stats.pending == 0
+    stores = [s for node in run.cluster.nodes.values()
+              for s in node.command_stores.all()]
+    assert all(s.mesh is not None for s in stores), \
+        "virtual mesh missing: the SPMD step was not exercised"
+    assert sum(s.device_hits for s in stores) > 0
+
+
+def test_burn_hostile_delayed_device_store():
+    """Delayed-executor nemesis composed OVER the device tier (store tasks
+    delay + cache-miss page-in, then enter the flush window) under loss."""
+    from accord_tpu.sim.delayed_store import delayed_device_factory
+    from accord_tpu.utils.random_source import RandomSource
+    run = BurnRun(53009, 60, drop_prob=0.15, partitions=True,
+                  num_command_stores=2,
+                  store_factory=delayed_device_factory(
+                      RandomSource(0x5D5D ^ 53009),
+                      flush_window_us=300, verify=True))
+    stats = run.run()
+    assert stats.lost == 0 and stats.pending == 0
+    stores = [s for node in run.cluster.nodes.values()
+              for s in node.command_stores.all()]
+    assert sum(s.device_hits for s in stores) > 0
+    assert sum(s.tasks_run for s in stores) > 0, \
+        "delayed executor never engaged: the composition is inert"
+
+
 def test_burn_device_store_wavefront_gates_execution():
     """The wavefront kernel must demonstrably drive in-window execution
     ordering (VERDICT r3 item 2): under a contended single-key-heavy

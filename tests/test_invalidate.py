@@ -139,6 +139,58 @@ class TestInvalidateDecisions:
         assert isinstance(res.failure(), Invalidated)
 
 
+class TestAcceptInvalidateSupersedesAcceptedValue:
+    def test_accept_invalidate_replaces_accepted_status(self):
+        """accept_invalidate on a command holding a slow-path ACCEPTED value
+        must supersede that value (status -> ACCEPTED_INVALIDATE), exactly
+        as the reference's Command.acceptInvalidated does unconditionally.
+        The pre-fix behavior kept status ACCEPTED while bumping
+        accepted_ballot, so a later recovery read the ORIGINAL value as
+        accepted at the invalidation's ballot and re-proposed a txn a
+        ballot-protected invalidation had already decided against —
+        a committed-vs-invalidated divergence (r5 soak seed 57012,
+        triage_57012.py; regression burn below)."""
+        from accord_tpu.local import commands as C
+        from accord_tpu.local.store import PreLoadContext, SafeCommandStore
+        from accord_tpu.primitives.deps import Deps
+        from accord_tpu.primitives.timestamp import Ballot, Timestamp
+
+        cluster = SimCluster(n_nodes=3, seed=57)
+        node = cluster.node(1)
+        store = node.command_stores.all()[0]
+        safe = SafeCommandStore(store, PreLoadContext.empty())
+        txn = rw_txn([], {10: 7})
+        from accord_tpu.primitives.timestamp import Domain
+        txn_id = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+        route = Route.of_keys(RoutingKeys.of(10)[0], RoutingKeys.of(10))
+        C.preaccept(safe, txn_id,
+                    txn.slice(store.ranges, include_query=False), route)
+        execute_at = Timestamp(txn_id.epoch, txn_id.hlc + 5, 0, 1)
+        assert C.accept(safe, txn_id, Ballot.ZERO, route, txn.keys,
+                        execute_at, Deps.NONE) == C.AcceptOutcome.SUCCESS
+        cmd = store.commands[txn_id]
+        assert cmd.save_status == SaveStatus.ACCEPTED
+
+        ballot = Ballot(txn_id.epoch, txn_id.hlc + 100, 0, 3)
+        assert C.accept_invalidate(safe, txn_id, ballot) \
+            == C.AcceptOutcome.SUCCESS
+        assert cmd.save_status == SaveStatus.ACCEPTED_INVALIDATE, \
+            "invalidate acceptance must supersede the prior accepted value"
+        assert cmd.accepted_ballot == ballot
+
+    def test_burn_regression_seed_57012(self):
+        """The soak seed that exposed the divergence: device store x 25%
+        loss x partitions x range-heavy x 4 stores."""
+        from accord_tpu.impl.device_store import DeviceCommandStore
+        from accord_tpu.sim.burn import BurnRun
+        run = BurnRun(57012, 60, drop_prob=0.25, partitions=True,
+                      range_every=3, num_command_stores=4,
+                      store_factory=DeviceCommandStore.factory(
+                          flush_window_us=300, verify=True))
+        stats = run.run()
+        assert stats.lost == 0 and stats.pending == 0
+
+
 class TestInvalidationTracker:
     def _topologies(self, n=3):
         shard = Shard(Range(0, 1000), list(range(1, n + 1)))

@@ -1,0 +1,151 @@
+"""Triage seed 57012: Propagate commit_invalidate onto a COMMITTED command
+(device-store x 25% loss x partitions x range-heavy arm, r5 soak).
+
+Taps every transition and coordinator decision touching the suspect txn,
+then replays the failing burn.
+"""
+import sys
+
+SUSPECT = "W[1,1000000,2]"
+
+CLUSTER = [None]
+
+
+def tap(who, what, **fields):
+    t = CLUSTER[0].queue.clock.now_us / 1e6 if CLUSTER[0] else -1
+    print(f"{t:10.3f} {who} {what} "
+          + " ".join(f"{k}={v}" for k, v in fields.items()), flush=True)
+
+
+def main():
+    from accord_tpu.utils.backend import force_cpu
+    force_cpu()
+    from accord_tpu.local import commands as C
+    from accord_tpu.coordinate import recover as R
+    from accord_tpu.coordinate import invalidate as I
+    from accord_tpu.impl.device_store import DeviceCommandStore
+    from accord_tpu.sim.burn import BurnRun
+
+    def match(txn_id):
+        return repr(txn_id) == SUSPECT
+
+    for name in ("preaccept", "recover", "accept", "accept_invalidate",
+                 "commit", "precommit", "commit_invalidate", "apply"):
+        orig = getattr(C, name)
+
+        def wrap(orig=orig, name=name):
+            def inner(safe_store, txn_id, *a, **kw):
+                if match(txn_id):
+                    cmd = safe_store.store.commands.get(txn_id)
+                    before = cmd.save_status.name if cmd else "NONE"
+                    out = orig(safe_store, txn_id, *a, **kw)
+                    cmd = safe_store.store.commands.get(txn_id)
+                    after = cmd.save_status.name if cmd else "NONE"
+                    extra = {}
+                    if cmd is not None:
+                        extra = dict(prom=cmd.promised,
+                                     acc=cmd.accepted_ballot,
+                                     at=cmd.execute_at)
+                    tap(f"n{safe_store.store.node.id}st{safe_store.store.id}",
+                        f"{name}", before=before, after=after,
+                        out=(out if not isinstance(out, tuple) else out[0]),
+                        **extra)
+                    return out
+                return orig(safe_store, txn_id, *a, **kw)
+            return inner
+        setattr(C, name, wrap())
+
+    import accord_tpu.messages.preaccept as MP
+    import accord_tpu.messages.accept as MA
+    import accord_tpu.messages.commit as MC
+    import accord_tpu.messages.apply_msg as MAp
+    import accord_tpu.messages.recover as MR
+    import accord_tpu.messages.propagate as MPr
+    for mod in (MP, MA, MC, MAp, MR, MPr):
+        mod.C = C
+
+    # Propagate decisions for the suspect
+    orig_papply = MPr.Propagate.apply
+
+    def papply(self, safe_store):
+        if match(self.txn_id):
+            k = self.known
+            tap(f"n{safe_store.store.node.id}st{safe_store.store.id}",
+                "Propagate.apply", status=k.save_status.name,
+                at=k.execute_at, inval_if=k.invalid_if_undecided)
+        return orig_papply(self, safe_store)
+    MPr.Propagate.apply = papply
+
+    # recovery decisions
+    orig_recover = R.Recover._recover
+
+    def rec(self):
+        if match(self.txn_id):
+            oks = {f: (ok.status.name, str(ok.accepted_ballot),
+                       str(ok.execute_at), ok.rejects_fast_path)
+                   for f, ok in self.oks.items()}
+            tap(f"n{self.node.id}", "Recover._recover", ballot=self.ballot,
+                oks=oks, tracker_rejects=self.tracker.rejects_fast_path())
+        return orig_recover(self)
+    R.Recover._recover = rec
+
+    for meth in [m for m in dir(R.Recover) if m.startswith("_")]:
+        if meth in ("_recover", "__init__", "__class__") \
+                or not callable(getattr(R.Recover, meth, None)) \
+                or meth.startswith("__"):
+            continue
+        orig = getattr(R.Recover, meth)
+
+        def wrapm(orig=orig, meth=meth):
+            def inner(self, *a, **kw):
+                if match(self.txn_id):
+                    tap(f"n{self.node.id}", f"Recover{meth}",
+                        ballot=self.ballot,
+                        arg=(repr(a[0])[:120] if a else ""))
+                return orig(self, *a, **kw)
+            return inner
+        setattr(R.Recover, meth, wrapm())
+
+    # invalidation coordinations
+    for cls_name in ("Invalidate", "ProposeInvalidate"):
+        cls = getattr(I, cls_name)
+        for meth in [m for m in dir(cls)
+                     if not m.startswith("__")
+                     and callable(getattr(cls, m, None))]:
+            orig = getattr(cls, meth)
+
+            def wrapi(orig=orig, meth=meth, cls_name=cls_name):
+                def inner(self, *a, **kw):
+                    if match(self.txn_id):
+                        tap(f"n{self.node.id}", f"{cls_name}.{meth}",
+                            ballot=getattr(self, "ballot", None),
+                            arg=(repr(a[0])[:140] if a else ""))
+                    return orig(self, *a, **kw)
+                return inner
+            setattr(cls, meth, wrapi())
+
+    orig_ci = I.commit_invalidate
+
+    def ci(node, txn_id, route):
+        if match(txn_id):
+            tap(f"n{node.id}", "coordinate.commit_invalidate(fanout)")
+        return orig_ci(node, txn_id, route)
+    I.commit_invalidate = ci
+    if hasattr(R, "commit_invalidate"):
+        R.commit_invalidate = ci
+
+    run = BurnRun(57012, 60, drop_prob=0.25, partitions=True, range_every=3,
+                  num_command_stores=4,
+                  store_factory=DeviceCommandStore.factory(
+                      flush_window_us=300, verify=True))
+    CLUSTER[0] = run.cluster
+    try:
+        run.run()
+        print("UNEXPECTED: run passed")
+    except Exception as e:
+        print(f"FAILED as expected: {type(e).__name__}: {e}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
