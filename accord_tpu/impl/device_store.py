@@ -508,26 +508,24 @@ class DeviceCommandStore(CommandStore):
             for before, kinds, keys in context.deps_probes:
                 if (before, kinds) in seen:
                     continue
-                if isinstance(keys, Ranges):
-                    # range-domain probe (sync point / range txn): its
-                    # per-key tier is the CFK walk over the keys inside the
-                    # ranges — materialize that key set at snapshot time so
-                    # the kernel precomputes it like any key probe (the
-                    # geometric range-command arm still goes to the stab
-                    # tier).  A key born after this snapshot fails the
-                    # serve-time cover check and falls back to scalar.
-                    owned_r = keys.intersection(self.ranges) \
-                        if not self.ranges.is_empty else keys
-                    owned = sorted(k for k in self.cfks
-                                   if owned_r.contains(k))
-                else:
-                    owned = keys.slice(self.ranges) \
-                        if not self.ranges.is_empty else keys
+                owned = self._snapshot_probe_keys(keys)
                 if len(owned) == 0:
                     continue
                 seen.add((before, kinds))
-                probes.append((before, kinds, list(owned)))
+                probes.append((before, kinds, owned))
         return probes
+
+    def _snapshot_probe_keys(self, keys) -> List[Key]:
+        """The owned KEY list a probe covers, at snapshot time.  A Ranges
+        probe (sync point / range txn) materializes to the CFK keys inside
+        the ranges — its per-key tier is exactly that walk; the geometric
+        range-command arm still goes to the stab tier.  A key born after
+        this snapshot fails the serve-time cover gate and falls back to
+        scalar."""
+        owned = keys.slice(self.ranges) if not self.ranges.is_empty else keys
+        if isinstance(owned, Ranges):
+            return sorted(k for k in self.cfks if owned.contains(k))
+        return list(owned)
 
     def _probe_snapshots(self, probes):
         touched = sorted({k for _, _, ks in probes for k in ks})
@@ -576,12 +574,11 @@ class DeviceCommandStore(CommandStore):
             for txn_id, keys in context.recovery_probes:
                 if txn_id in seen:
                     continue
-                owned = keys.slice(self.ranges) if not self.ranges.is_empty \
-                    else keys
+                owned = self._snapshot_probe_keys(keys)
                 if len(owned) == 0:
                     continue
                 seen.add(txn_id)
-                probes.append((txn_id, list(owned)))
+                probes.append((txn_id, owned))
         if not probes:
             return
 

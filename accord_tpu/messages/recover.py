@@ -184,13 +184,15 @@ class BeginRecovery(TxnRequest):
         return self.scope.participant_keys()
 
     def recovery_probe(self):
-        from accord_tpu.primitives.keys import Keys
-        if self.partial_txn is not None \
-                and isinstance(self.partial_txn.keys, Keys):
+        # Keys OR Ranges: the device store materializes a Ranges probe into
+        # the CFK keys inside the ranges at snapshot time (the per-key
+        # predicate tier a range-domain recovery walks), with serve-time
+        # cover/version gates guarding any divergence
+        if self.partial_txn is not None:
             return (self.txn_id, self.partial_txn.keys)
         if self.scope.is_key_domain:
             return (self.txn_id, self.scope.participant_keys())
-        return None  # range-domain recovery: the key tier has no probe
+        return (self.txn_id, self.scope.ranges)
 
     def deps_probe(self):
         # apply() also contributes a fresh local deps calculation when no
