@@ -107,6 +107,11 @@ class _RecoveryProbe:
         self.versions = versions
 
 
+# trivially-servable recovery probe for participants with no CFK state:
+# every predicate tier is empty, nothing to scan
+_EMPTY_RECOVERY = _RecoveryProbe(None, {}, {}, {}, {}, set(), {})
+
+
 class DeviceSafeCommandStore(SafeCommandStore):
     def map_reduce_active(self, participants, before: Timestamp,
                           kinds: KindSet, fn, on_range_dep=None,
@@ -232,14 +237,18 @@ class DeviceSafeCommandStore(SafeCommandStore):
     # ---------------------------------------------- recovery scans (keys) --
     def _recovery_servable(self, txn_id: TxnId, participants):
         """The precomputed recovery probe and the owned KEY list, when every
-        queried key is covered and exactly at its snapshot version."""
+        queried key is covered and exactly at its snapshot version.  An
+        empty key list (no CFK state inside the participants — collection
+        skips such probes too) serves trivially, matching the deps arm."""
         store: DeviceCommandStore = self.store
-        probe = store._precomputed_recovery.get(txn_id)
-        if probe is None:
-            return None, None
         owned = self._owned_participants(participants)
         keys = (self._owned_cfk_keys(owned) if isinstance(owned, Ranges)
                 else list(owned))
+        if not keys:
+            return _EMPTY_RECOVERY, []
+        probe = store._precomputed_recovery.get(txn_id)
+        if probe is None:
+            return None, None
         for k in keys:
             cfk = store.cfks.get(k)
             v = cfk.version if cfk is not None else 0
