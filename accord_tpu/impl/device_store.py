@@ -74,16 +74,18 @@ class _RangeProbe:
     snapshot falls back to the scalar walk)."""
 
     __slots__ = ("before", "kinds", "mode", "owned_repr", "candidates",
-                 "version")
+                 "version", "log_len")
 
     def __init__(self, before: Timestamp, kinds: KindSet, mode: str,
-                 owned_repr, candidates: Tuple[TxnId, ...], version: int):
+                 owned_repr, candidates: Tuple[TxnId, ...], version: int,
+                 log_len: int = 0):
         self.before = before
         self.kinds = kinds
         self.mode = mode            # "keys" | "ranges"
         self.owned_repr = owned_repr
         self.candidates = candidates
         self.version = version
+        self.log_len = log_len      # range_log length at snapshot
 
 
 class _RecoveryProbe:
@@ -167,7 +169,7 @@ class DeviceSafeCommandStore(SafeCommandStore):
         if not store.range_commands:
             return  # scalar walk over an empty index is a no-op
         probe = store._precomputed_ranges.get((before, kinds))
-        ok = probe is not None and probe.version == store.range_version
+        ok = probe is not None
         if ok:
             if is_range:
                 ok = probe.mode == "ranges" and probe.owned_repr == tuple(
@@ -179,14 +181,37 @@ class DeviceSafeCommandStore(SafeCommandStore):
             store.device_range_misses += 1
             return super()._map_range_conflicts(owned, is_range, before,
                                                 kinds, fn, on_range_dep)
+        if probe.version != store.range_version:
+            if store.range_log is None:
+                # delta unavailable (tier disabled mid-window): stale probe
+                # is unservable
+                store.device_range_misses += 1
+                return super()._map_range_conflicts(owned, is_range, before,
+                                                    kinds, fn, on_range_dep)
+            # the index mutated since the snapshot.  Deletions are safe —
+            # the live activity/overlap filters below drop them — and every
+            # addition or re-registration since the snapshot is in the
+            # append-only range_log suffix: union it into the candidate
+            # set (the geometric prune is lost only for the delta, whose
+            # non-intersecting entries the overlap filter discards).
+            # Refresh the probe IN PLACE so repeat serves in this window
+            # take the version-match fast path.
+            delta = store.range_log[probe.log_len:]
+            if delta:
+                seen_c = set(probe.candidates)
+                probe.candidates = probe.candidates + tuple(
+                    d for d in dict.fromkeys(delta) if d not in seen_c)
+            probe.version = store.range_version
+            probe.log_len = len(store.range_log)
+        candidates = probe.candidates
         store.device_range_hits += 1
         served = []
-        for txn_id in probe.candidates:
+        for txn_id in candidates:
             if not self._active_range_conflict(txn_id, before, kinds):
                 continue
             ranges = store.range_commands.get(txn_id)
             if ranges is None:
-                continue  # unreachable under the version gate
+                continue  # cleaned up since the snapshot: no conflict
             if is_range:
                 overlap = ranges.intersection(owned)
             else:
@@ -442,6 +467,9 @@ class DeviceCommandStore(CommandStore):
         # drops): the store keeps serving every scan through the scalar
         # path instead of crashing the node
         self.device_disabled = False
+        # enable the range-registration delta log (local/store.py); the
+        # flush boundary clears it together with the probes it serves
+        self.range_log = []
 
     @classmethod
     def factory(cls, flush_window_us: int = 0, verify: bool = False,
@@ -496,6 +524,7 @@ class DeviceCommandStore(CommandStore):
                 self._precomputed = {}
                 self._precomputed_recovery = {}
                 self._precomputed_ranges = {}
+                self.range_log = None  # no consumer remains; stop logging
                 self.agent.on_handled_exception(exc)
         if plan is not None:
             window = self._schedule_window(window, plan)
@@ -506,6 +535,9 @@ class DeviceCommandStore(CommandStore):
             self._precomputed = {}
             self._precomputed_recovery = {}
             self._precomputed_ranges = {}
+            if self.range_log is not None:
+                # probes are gone; rebase the delta log so it stays bounded
+                self.range_log.clear()
             if plan is not None:
                 self._account_wave_execution(plan)
 
@@ -697,7 +729,7 @@ class DeviceCommandStore(CommandStore):
             self.device_range_candidates += len(cand)
             self._precomputed_ranges[(before, kinds)] = _RangeProbe(
                 before, kinds, mode, owned_repr, tuple(sorted(cand)),
-                version)
+                version, log_len=len(self.range_log))
 
     # ------------------------------------------------ wavefront scheduling --
     def _plan_waves(self, window):

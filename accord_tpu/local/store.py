@@ -171,6 +171,11 @@ class SafeCommandStore:
 
     def register_range_txn(self, command: Command, ranges: Ranges) -> None:
         self.store.range_version += 1
+        # append-only registration log: lets a device range probe taken at
+        # an older version serve by unioning the additions since its
+        # snapshot (deletions are dropped by the live activity filter)
+        if self.store.range_log is not None:
+            self.store.range_log.append(command.txn_id)
         self.store.range_commands[command.txn_id] = ranges.slice(self.ranges) \
             if not self.ranges.is_empty else ranges
 
@@ -472,6 +477,12 @@ class CommandStore:
         # bumped on any range_commands mutation (register/cleanup): the
         # device store's batched range-stab probes are version-gated on it
         self.range_version = 0
+        # append-only log of range-txn registrations (incl. re-registered
+        # ids): a device probe serves across version bumps by unioning the
+        # log suffix past its snapshot into its candidate set.  None on
+        # stores with no consumer (the device store enables it and clears
+        # it at every flush-window boundary, so it stays bounded)
+        self.range_log: Optional[List[TxnId]] = None
         self.max_conflicts = MaxConflicts()
         self.redundant_before = RedundantBefore()
         self.durable_before = DurableBefore()

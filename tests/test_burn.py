@@ -102,6 +102,27 @@ class TestBurn:
             assert t1[n] == t2[n], f"node {n} event streams diverged"
         assert any(t1[n] for n in t1), "no events were traced"
 
+    def test_burn_reconcile_device_store(self):
+        """Determinism of the DEVICE tier: the same seed with the batched
+        device store (flush windows, kernel-served scans, loss) must replay
+        event-for-event identically — the burn oracle's bit-exactness
+        contract extends to scheduling, not just scan results."""
+        from accord_tpu.impl.device_store import DeviceCommandStore
+
+        def traced_run():
+            r = BurnRun(19, ops=40, drop_prob=0.1, trace=True,
+                        store_factory=DeviceCommandStore.factory(
+                            flush_window_us=300, verify=True))
+            r.run()
+            return {n: list(r.cluster.node(n).trace.ring)
+                    for n in r.cluster.nodes}
+
+        t1 = traced_run()
+        t2 = traced_run()
+        for n in t1:
+            assert t1[n] == t2[n], f"node {n} event streams diverged"
+        assert any(t1[n] for n in t1)
+
     def test_burn_partial_rf(self):
         # rf 3 of 5 nodes: not every node replicates every key
         stats = BurnRun(42, ops=60, nodes=5, rf=3, n_shards=4).run()
