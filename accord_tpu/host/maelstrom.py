@@ -67,6 +67,18 @@ def key_token(k) -> int:
 
 
 class HostAgent(Agent):
+    def __init__(self):
+        # env-tunable RPC timeout, parsed once (constant for the process):
+        # a device-store host (ACCORD_TCP_DEVICE_STORE) whose first flush
+        # jit-compiles inside the dispatch loop needs rounds to survive
+        # multi-second peer stalls
+        import os
+        try:
+            self._rpc_timeout_s = float(
+                os.environ.get("ACCORD_HOST_RPC_TIMEOUT_S", "1.0"))
+        except ValueError:
+            self._rpc_timeout_s = 1.0
+
     def on_uncaught_exception(self, failure: BaseException) -> None:
         print(f"uncaught: {failure!r}", file=sys.stderr, flush=True)
 
@@ -76,7 +88,7 @@ class HostAgent(Agent):
         print(f"handled: {failure!r}", file=sys.stderr, flush=True)
 
     def pre_accept_timeout(self) -> float:
-        return 1.0
+        return self._rpc_timeout_s
 
     def empty_txn(self, kind: TxnKind, keys_or_ranges) -> Txn:
         return Txn(kind, keys_or_ranges)

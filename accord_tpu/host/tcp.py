@@ -150,6 +150,33 @@ class _PeerWriter:
             self.sock = None
 
 
+def _env_store_factory():
+    """Optional batched-device command stores for the real-socket host:
+    ACCORD_TCP_DEVICE_STORE=1 puts DeviceCommandStore behind every node
+    (flush window ACCORD_TCP_FLUSH_US wall-clock µs, default 1000; inline
+    scalar verification with ACCORD_TCP_DEVICE_VERIFY=1).  The same tier
+    the burn exercises, demonstrated on the black-box transport."""
+    import os
+    if os.environ.get("ACCORD_TCP_DEVICE_STORE", "") != "1":
+        return None
+    from accord_tpu.utils.backend import resolve_platform
+    resolve_platform()  # pin CPU if the tunneled device backend is dead
+    # multi-process mode: every node process would otherwise pay the full
+    # first-jit cost inside its dispatch loop (stalling peers' RPC rounds);
+    # a persistent compilation cache amortizes it across processes and runs
+    import jax
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("ACCORD_JAX_CACHE", "/tmp/accord_jax_cache"))
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+    from accord_tpu.impl.device_store import DeviceCommandStore
+    return DeviceCommandStore.factory(
+        flush_window_us=int(os.environ.get("ACCORD_TCP_FLUSH_US", "1000")),
+        verify=os.environ.get("ACCORD_TCP_DEVICE_VERIFY", "") == "1")
+
+
 class TcpHost:
     """One Accord node bound to a TCP port, peered with `peers`
     (node_id -> (host, port), including itself)."""
@@ -182,6 +209,7 @@ class TcpHost:
         self.scheduler.on_error = agent.on_uncaught_exception
         self.node = Node(my_id, self.sink, agent, self.scheduler,
                          ListStore(my_id), RandomSource(my_id), num_shards=1,
+                         store_factory=_env_store_factory(),
                          now_us=lambda: int(time.time() * 1e6))
         self.node.on_topology_update(topology)
 

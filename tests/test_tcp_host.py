@@ -57,3 +57,54 @@ def test_three_node_tcp_cluster_strict_serializable():
     finally:
         for h in hosts.values():
             h.close()
+
+
+@pytest.mark.slow
+def test_tcp_cluster_with_device_stores(monkeypatch):
+    """The batched device tier behind the REAL-SOCKET host: every node runs
+    DeviceCommandStore (wall-clock flush windows) with inline scalar
+    verification on, txns commit over TCP, and scans are device-served."""
+    monkeypatch.setenv("ACCORD_TCP_DEVICE_STORE", "1")
+    monkeypatch.setenv("ACCORD_TCP_DEVICE_VERIFY", "1")
+    monkeypatch.setenv("ACCORD_TCP_FLUSH_US", "500")
+    # warm the device kernels through the REAL code paths in-process (the
+    # jit cache is per-process and shared with the hosts below): a node
+    # whose dispatch loop stalls on a first-compile makes its peers'
+    # wall-clock RPC rounds time out
+    from accord_tpu.impl.device_store import DeviceCommandStore
+    from accord_tpu.sim.burn import BurnRun
+    BurnRun(3, 8, nodes=3, keys=4,
+            store_factory=DeviceCommandStore.factory(
+                flush_window_us=300, verify=True)).run()
+    ports = {1: ("127.0.0.1", 0), 2: ("127.0.0.1", 0), 3: ("127.0.0.1", 0)}
+    hosts = {}
+    try:
+        hosts[1] = TcpHost(1, ports)
+        ports = dict(hosts[1].peers)
+        hosts[2] = TcpHost(2, ports)
+        ports = dict(hosts[2].peers)
+        hosts[3] = TcpHost(3, ports)
+        ports = dict(hosts[3].peers)
+        for h in hosts.values():
+            h.peers.update(ports)
+
+        value = 0
+        for i in range(20):
+            h = hosts[1 + i % 3]
+            token = 10 + (i % 3)
+            value += 1
+            res = h.submit([token], {token: value}).wait(30.0)
+            if res.failure is not None:
+                # a residual-compile stall can time one protocol round out;
+                # a client resubmit (jepsen-style) must then succeed
+                res = h.submit([token], {token: value}).wait(30.0)
+            assert res.failure is None, res.failure
+        stores = [s for h in hosts.values()
+                  for s in h.node.command_stores.all()]
+        assert all(isinstance(s, DeviceCommandStore) for s in stores)
+        hits = sum(s.device_hits for s in stores)
+        assert hits > 0, "no scan was device-served on the TCP host"
+        assert not any(s.device_disabled for s in stores)
+    finally:
+        for h in hosts.values():
+            h.close()
