@@ -245,3 +245,77 @@ class TestTopologyManager:
         tm.on_topology_update(topo(epoch=1))
         with pytest.raises(InvariantError):
             tm.on_topology_update(topo(epoch=3))
+
+
+class TestPerRangeSyncProperties:
+    """Randomized invariants of the per-range sync unlock (reference
+    TopologyManagerTest's randomized coverage of syncCompleteFor).
+
+    1. sync_complete_for(sel) == every shard range intersecting sel has a
+       sync quorum (recomputed independently from the raw ack sets);
+    2. with_unsynced_epochs never widens PAST the newest epoch whose
+       selection-ranges are all quorum-synced, and always widens when they
+       are not;
+    3. unlock is monotone: acks only ever grow the synced selection set;
+    4. whole-epoch sync_complete == every shard range unlocked.
+    """
+
+    def test_randomized_per_range_sync_invariants(self):
+        from accord_tpu.utils.random_source import RandomSource
+        from accord_tpu.topology.manager import TopologyManager
+
+        for seed in range(30):
+            rng = RandomSource(900 + seed)
+            n_shards = rng.next_int(1, 5)            # [1, 4]
+            width = 120 // n_shards
+            n_nodes = rng.next_int(3, 8)             # [3, 7]
+            shards = []
+            for i in range(n_shards):
+                rf = rng.next_int(3, min(6, n_nodes + 1))  # [3, min(5, n)]
+                pool = rng.shuffle(list(range(1, n_nodes + 1)))
+                nodes = sorted(pool[:rf])
+                shards.append(Shard(Range(i * width, (i + 1) * width), nodes))
+            tm = TopologyManager(node_id=1)
+            tm.on_topology_update(Topology(1, shards))
+            tm.on_topology_update(Topology(2, shards))
+
+            acked: set = set()
+            all_acks = rng.shuffle(
+                [(n, 2) for n in {n for s in shards for n in s.nodes}])
+            prev_unlocked: set = set()
+            for node, epoch in all_acks:
+                tm.on_epoch_sync_complete(node, epoch)
+                acked.add(node)
+                unlocked = set()
+                quorate = {}
+                for s in shards:
+                    sel = Keys.of(s.range.start + 1)
+                    got = tm.sync_complete_for(2, sel)
+                    # invariant 1: matches the independent quorum recompute
+                    want = sum(1 for n in s.nodes if n in acked) \
+                        >= s.slow_path_quorum_size
+                    quorate[s.range] = want
+                    assert got == want, (seed, s, acked)
+                    if got:
+                        unlocked.add(s.range.start)
+                        # invariant 2: precise window on unlocked ranges
+                        w = tm.with_unsynced_epochs(sel, 2, 2)
+                        assert (w.oldest_epoch, w.current_epoch) == (2, 2)
+                    else:
+                        w = tm.with_unsynced_epochs(sel, 2, 2)
+                        assert (w.oldest_epoch, w.current_epoch) == (1, 2)
+                # a RANGES selection spanning two adjacent shards unlocks
+                # iff BOTH are quorate — the multi-range _covered_by branch
+                # asserted in the discriminating mixed state
+                for a, b in zip(shards, shards[1:]):
+                    span = Ranges.of((a.range.start + 1, b.range.end - 1))
+                    assert tm.sync_complete_for(2, span) == (
+                        quorate[a.range] and quorate[b.range]), (seed, acked)
+                # invariant 3: monotone growth
+                assert prev_unlocked <= unlocked, (seed, acked)
+                prev_unlocked = unlocked
+            # invariant 4: all acks in -> epoch fully synced
+            assert tm.is_sync_complete(2)
+            for s in shards:
+                assert tm.sync_complete_for(2, Ranges.of(
+                    (s.range.start, s.range.end)))
