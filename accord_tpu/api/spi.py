@@ -76,11 +76,21 @@ class CallbackSink(MessageSink):
     host MaelstromSink). Entries are released BOTH on reply delivery and on
     RPC timeout — registration installs an unregister hook the node's safe
     callback fires when its timer expires, so a long-lived host under
-    partitions does not pin dead coordination state forever."""
+    partitions does not pin dead coordination state forever.
+
+    Also carries the ingest pipeline's coalescing-window machinery
+    (accord_tpu/pipeline/): between `batch_begin()` and `batch_flush()`,
+    concrete sinks route every outbound request through `_capture` instead
+    of the transport; the flush then emits ONE MultiPreAccept envelope per
+    destination (single-request groups go out unwrapped via the sink's
+    `_send_prepared`).  Windows nest (a batch dispatch inside a host loop
+    tick): only the outermost flush actually sends."""
 
     def __init__(self):
         self._seq = 0
         self._callbacks: dict = {}
+        self._batch: dict = None      # dest -> [(reply_context, request)]
+        self._batch_depth = 0
 
     def _register(self, callback) -> int:
         self._seq += 1
@@ -97,6 +107,51 @@ class CallbackSink(MessageSink):
         callback = self._callbacks.pop(msg_id, None)
         if callback is not None:
             callback.deliver(reply)
+
+    # ------------------------------------------------- coalescing windows --
+    def batch_begin(self) -> None:
+        """Open (or deepen) a coalescing window: outbound requests are
+        captured per destination until the matching batch_flush."""
+        if self._batch_depth == 0:
+            self._batch = {}
+        self._batch_depth += 1
+
+    def batch_flush(self) -> None:
+        """Close one window level; on closing the outermost level, emit one
+        envelope per destination (unwrapped when a group holds a single
+        request — no reason to pay envelope framing for a lone message)."""
+        if self._batch_depth == 0:
+            return
+        self._batch_depth -= 1
+        if self._batch_depth > 0:
+            return
+        groups, self._batch = self._batch, None
+        if not groups:
+            return
+        from accord_tpu.messages.multi import MultiPreAccept
+        for to, parts in groups.items():
+            if len(parts) == 1:
+                self._send_prepared(to, parts[0][0], parts[0][1])
+            else:
+                self.send(to, MultiPreAccept(parts))
+
+    def _capture(self, to: int, reply_context, request) -> bool:
+        """Concrete sinks call this first in send/send_with_callback; True
+        means the request was captured into the open window (the callback,
+        if any, is already registered — `reply_context` is its transport
+        token) and must not be sent now."""
+        if self._batch is None:
+            return False
+        self._batch.setdefault(to, []).append((reply_context, request))
+        return True
+
+    def _send_prepared(self, to: int, reply_context, request) -> None:
+        """Transport-specific raw send of a request whose callback (when
+        present) is ALREADY registered under `reply_context`.  Concrete
+        sinks override; the fallback wraps in a single-part envelope, which
+        is always correct."""
+        from accord_tpu.messages.multi import MultiPreAccept
+        self.send(to, MultiPreAccept([(reply_context, request)]))
 
 
 class EpochReady:

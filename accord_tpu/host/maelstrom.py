@@ -102,14 +102,24 @@ class MaelstromSink(CallbackSink):
         self.host = host
 
     def send(self, to: int, request: Request) -> None:
+        if self._capture(to, None, request):
+            return
         self.host.emit_node(to, {"type": "accord",
                                  "payload": encode_message(request)})
 
     def send_with_callback(self, to: int, request: Request, callback,
                            executor=None) -> None:
         msg_id = self._register(callback)
+        if self._capture(to, msg_id, request):
+            return
         self.host.emit_node(to, {"type": "accord", "msg_id": msg_id,
                                  "payload": encode_message(request)})
+
+    def _send_prepared(self, to: int, reply_context, request) -> None:
+        body = {"type": "accord", "payload": encode_message(request)}
+        if reply_context is not None:
+            body["msg_id"] = reply_context
+        self.host.emit_node(to, body)
 
     def reply(self, to: int, reply_context, reply: Reply) -> None:
         if reply_context is None:
@@ -125,6 +135,7 @@ class MaelstromHost:
         self.stdout = stdout if stdout is not None else sys.stdout
         self.rf = rf
         self.node = None
+        self.pipeline = None  # built with the node when ACCORD_PIPELINE=1
         self.node_name = ""
         self.names: Dict[int, str] = {}
         self.scheduler = RealTimeScheduler()
@@ -158,6 +169,13 @@ class MaelstromHost:
                          num_shards=1,
                          now_us=lambda: int(time.time() * 1e6))
         self.node.on_topology_update(topology)
+        # ACCORD_PIPELINE=1: continuous micro-batching ingest (same layer
+        # the TCP host wires; see accord_tpu/pipeline/).  Default off.
+        from accord_tpu.pipeline import (Pipeline, PipelineConfig,
+                                         pipeline_enabled)
+        self.pipeline = Pipeline(self.node, self.scheduler,
+                                 PipelineConfig.from_env()) \
+            if pipeline_enabled() else None
 
     # ------------------------------------------------------------ handlers --
     def handle(self, envelope: dict) -> None:
@@ -238,7 +256,10 @@ class MaelstromHost:
             self._emit(client, {"type": "txn_ok", "in_reply_to": msg_id,
                                 "txn": out})
 
-        self.node.coordinate(txn).add_callback(done)
+        if self.pipeline is not None:
+            self.pipeline.submit(txn).add_callback(done)
+        else:
+            self.node.coordinate(txn).add_callback(done)
 
     def _handle_accord(self, src: str, body: dict) -> None:
         payload = decode_message(body["payload"])
@@ -264,22 +285,40 @@ class MaelstromHost:
             lines.put(None)
 
         threading.Thread(target=reader, daemon=True).start()
-        while self.running:
+        eof = False
+        while self.running and not eof:
             deadline = self.scheduler.next_deadline()
             timeout = (max(0.0, deadline - time.monotonic())
                        if deadline is not None else 0.5)
             try:
-                line = lines.get(timeout=min(timeout, 0.5) or 0.01)
+                batch = [lines.get(timeout=min(timeout, 0.5) or 0.01)]
             except queue.Empty:
-                line = ""
-            if line is None:
-                break
-            if line and line.strip():
+                batch = []
+            # pipeline mode: drain the stdin burst and process it under one
+            # sink coalescing window (same-destination messages the burst
+            # produces leave as one envelope per peer per tick)
+            while self.pipeline is not None and len(batch) < 64:
                 try:
-                    self.handle(json.loads(line))
-                except Exception as e:  # noqa: BLE001
-                    print(f"handle error: {e!r} on {line[:200]}",
-                          file=sys.stderr, flush=True)
+                    batch.append(lines.get_nowait())
+                except queue.Empty:
+                    break
+            coalesce = self.pipeline is not None and len(batch) > 1
+            if coalesce:
+                self.sink.batch_begin()
+            try:
+                for line in batch:
+                    if line is None:
+                        eof = True
+                        break
+                    if line and line.strip():
+                        try:
+                            self.handle(json.loads(line))
+                        except Exception as e:  # noqa: BLE001
+                            print(f"handle error: {e!r} on {line[:200]}",
+                                  file=sys.stderr, flush=True)
+            finally:
+                if coalesce:
+                    self.sink.batch_flush()
             self.scheduler.run_due()
 
 

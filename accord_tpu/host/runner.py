@@ -75,11 +75,16 @@ class HostProcess:
 class MaelstromRunner:
     """Drives N host processes; acts as all Maelstrom clients at once."""
 
-    def __init__(self, n_nodes: int = 3, seed: int = 0):
+    def __init__(self, n_nodes: int = 3, seed: int = 0,
+                 pipeline: bool = False):
         self.names = [f"n{i + 1}" for i in range(n_nodes)]
         self.inbox: "queue.Queue" = queue.Queue()
+        # pipeline=True turns on the continuous micro-batching ingest layer
+        # in every node process (accord_tpu/pipeline/, ACCORD_PIPELINE=1)
+        extra_env = {"ACCORD_PIPELINE": "1"} if pipeline else None
         self.procs: Dict[str, HostProcess] = {
-            name: HostProcess(name, self.inbox) for name in self.names}
+            name: HostProcess(name, self.inbox, extra_env=extra_env)
+            for name in self.names}
         self.seed = seed
         self._msg_seq = 0
         self.pending: Dict[int, dict] = {}   # msg_id -> op record
@@ -257,8 +262,11 @@ def main():
     ap.add_argument("-o", "--ops", type=int, default=40)
     ap.add_argument("-k", "--keys", type=int, default=8)
     ap.add_argument("-s", "--seed", type=int, default=0)
+    ap.add_argument("--pipeline", action="store_true",
+                    help="continuous micro-batching ingest in every node "
+                         "process (ACCORD_PIPELINE=1)")
     ns = ap.parse_args()
-    runner = MaelstromRunner(ns.nodes, ns.seed)
+    runner = MaelstromRunner(ns.nodes, ns.seed, pipeline=ns.pipeline)
     try:
         t0 = time.monotonic()
         runner.init_all()

@@ -17,6 +17,7 @@ the same loop and hands back a thread-safe future.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import socket
 import struct
@@ -102,21 +103,40 @@ class SubmitResult:
 class _PeerWriter:
     """Owns the outbound connection to one peer: a dedicated thread drains a
     bounded queue, (re)connecting as needed, so slow/blackholed peers only
-    back up their own lane. Frames to a dead peer are dropped — RPC
-    timeouts and the progress log heal, exactly like a lossy link."""
+    back up their own lane.
+
+    In-flight fan-out is bounded by a per-peer semaphore (default 512
+    frames, ACCORD_TCP_PEER_INFLIGHT): with pipeline coalescing one frame
+    can carry a whole batch's requests, so the old 10k-frame queue bound
+    alone would let a burst overrun a slow replica by megabytes.  A failed
+    send is retried with exponential backoff (reconnecting between
+    attempts) before the frame is finally dropped — transient stalls no
+    longer cost a frame, while a genuinely dead peer still degrades to the
+    lossy-link model (RPC timeouts and the progress log heal)."""
 
     def __init__(self, host: "TcpHost", to: int):
+        from accord_tpu.pipeline.backpressure import SendBackoff
         self.host = host
         self.to = to
-        self.queue: "queue.Queue" = queue.Queue(maxsize=10_000)
+        max_inflight = _env_int("ACCORD_TCP_PEER_INFLIGHT", 512)
+        self.queue: "queue.Queue" = queue.Queue(maxsize=max_inflight)
+        self.inflight = threading.BoundedSemaphore(max_inflight)
+        self.backoff = SendBackoff()
+        self.shed = 0       # frames dropped at enqueue (peer lane full)
+        self.send_drops = 0  # frames dropped after exhausting retries
+        self.retries = 0
         self.sock: Optional[socket.socket] = None
         threading.Thread(target=self._drain, daemon=True).start()
 
     def enqueue(self, frame: dict) -> None:
+        if not self.inflight.acquire(blocking=False):
+            self.shed += 1  # backpressure: shed like a drop-tail link
+            return
         try:
             self.queue.put_nowait(frame)
-        except queue.Full:
-            pass  # backpressure: shed like a drop-tail link
+        except queue.Full:  # unreachable (semaphore == queue bound); belt
+            self.inflight.release()
+            self.shed += 1
 
     def _drain(self) -> None:
         while self.host.running:
@@ -124,6 +144,14 @@ class _PeerWriter:
                 frame = self.queue.get(timeout=0.2)
             except queue.Empty:
                 continue
+            try:
+                self._send_with_retry(frame)
+            finally:
+                self.inflight.release()
+
+    def _send_with_retry(self, frame: dict) -> None:
+        attempt = 0
+        while self.host.running:
             try:
                 if self.sock is None:
                     self.sock = socket.create_connection(
@@ -133,13 +161,21 @@ class _PeerWriter:
                     self.sock.setsockopt(socket.IPPROTO_TCP,
                                          socket.TCP_NODELAY, 1)
                 _send_frame(self.sock, frame)
+                return
             except OSError:
                 if self.sock is not None:
                     try:
                         self.sock.close()
                     except OSError:
                         pass
-                self.sock = None  # drop the frame; reconnect on the next
+                self.sock = None
+                attempt += 1
+                delay = self.backoff.delay_s(attempt)
+                if delay is None:
+                    self.send_drops += 1  # dead peer: drop, timeouts heal
+                    return
+                self.retries += 1
+                time.sleep(delay)  # only this peer's lane stalls
 
     def close(self) -> None:
         if self.sock is not None:
@@ -150,13 +186,19 @@ class _PeerWriter:
             self.sock = None
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def _env_store_factory():
     """Optional batched-device command stores for the real-socket host:
     ACCORD_TCP_DEVICE_STORE=1 puts DeviceCommandStore behind every node
     (flush window ACCORD_TCP_FLUSH_US wall-clock µs, default 1000; inline
     scalar verification with ACCORD_TCP_DEVICE_VERIFY=1).  The same tier
     the burn exercises, demonstrated on the black-box transport."""
-    import os
     if os.environ.get("ACCORD_TCP_DEVICE_STORE", "") != "1":
         return None
     from accord_tpu.utils.backend import resolve_platform
@@ -212,6 +254,17 @@ class TcpHost:
                          store_factory=_env_store_factory(),
                          now_us=lambda: int(time.time() * 1e6))
         self.node.on_topology_update(topology)
+
+        # ACCORD_PIPELINE=1: continuous micro-batching ingest — client
+        # submissions coalesce into deadline-bounded batches whose fan-out
+        # leaves as one MultiPreAccept envelope per replica (and whose
+        # self-addressed slice the device store resolves as one fused
+        # probe window).  Default off.
+        from accord_tpu.pipeline import (Pipeline, PipelineConfig,
+                                         pipeline_enabled)
+        self.pipeline = Pipeline(self.node, self.scheduler,
+                                 PipelineConfig.from_env()) \
+            if pipeline_enabled() else None
 
         threading.Thread(target=self._accept_loop, daemon=True).start()
         self.loop_thread = threading.Thread(target=self._run, daemon=True)
@@ -278,26 +331,45 @@ class TcpHost:
             pr.dump_stats(f"{prof_path}.{self.my_id}")
 
     def _run_loop(self) -> None:
+        # pipeline mode drains the inbox in bursts under one sink
+        # coalescing window: every same-destination message a burst
+        # produces (Commits fanned out by a batch of PreAccept replies,
+        # reads, applies) leaves as one envelope per replica per tick
+        burst = 64 if self.pipeline is not None else 1
         while self.running:
             deadline = self.scheduler.next_deadline()
             timeout = (max(0.0, deadline - time.monotonic())
                        if deadline is not None else 0.2)
             try:
-                kind, item = self.inbox.get(timeout=min(timeout, 0.2) or 0.01)
+                items = [self.inbox.get(timeout=min(timeout, 0.2) or 0.01)]
             except queue.Empty:
-                kind, item = "", None
+                items = []
+            while len(items) < burst:
+                try:
+                    items.append(self.inbox.get_nowait())
+                except queue.Empty:
+                    break
+            coalesce = self.pipeline is not None and len(items) > 1
+            if coalesce:
+                self.sink.batch_begin()
             try:
-                if kind == "frame":
-                    self._dispatch(item)
-                elif kind == "call":
-                    item()
-            except Exception as e:  # noqa: BLE001 — one bad frame/callback
-                # must never kill the node's only loop thread.  stderr: the
-                # parent reads stdout exactly once (the ready line) — a
-                # full stdout pipe would block this, the node's ONLY thread
-                import sys as _sys
-                print(f"tcp host n{self.my_id} dispatch error: {e!r}",
-                      file=_sys.stderr, flush=True)
+                for kind, item in items:
+                    try:
+                        if kind == "frame":
+                            self._dispatch(item)
+                        elif kind == "call":
+                            item()
+                    except Exception as e:  # noqa: BLE001 — one bad frame/
+                        # callback must never kill the node's only loop
+                        # thread.  stderr: the parent reads stdout exactly
+                        # once (the ready line) — a full stdout pipe would
+                        # block this, the node's ONLY thread
+                        import sys as _sys
+                        print(f"tcp host n{self.my_id} dispatch error: "
+                              f"{e!r}", file=_sys.stderr, flush=True)
+            finally:
+                if coalesce:
+                    self.sink.batch_flush()
             self.scheduler.run_due()
 
     def _dispatch(self, frame: dict) -> None:
@@ -328,22 +400,33 @@ class TcpHost:
         req = body.get("req")
 
         def done(value, failure):
+            from accord_tpu.pipeline.backpressure import Rejected
             reads = {}
             if failure is None and value is not None:
                 reads = {k.token: list(v)
                          for k, v in value.read_values.items()}
-            self.emit(from_id, {"type": "submit_reply", "req": req,
-                                "ok": failure is None,
-                                "error": repr(failure) if failure else None,
-                                "reads": reads})
+            reply = {"type": "submit_reply", "req": req,
+                     "ok": failure is None,
+                     "error": repr(failure) if failure else None,
+                     "reads": reads}
+            if isinstance(failure, Rejected):
+                # typed load-shed: never coordinated, safe to retry
+                reply["shed"] = True
+            self.emit(from_id, reply)
 
         try:
             read_tokens = body.get("reads", [])
             appends = {int(t): v for t, v in body.get("appends", {}).items()}
             txn = _build_list_txn(read_tokens, appends)
-            self.node.coordinate(txn).add_callback(done)
+            self._coordinate(txn).add_callback(done)
         except BaseException as e:  # noqa: BLE001
             done(None, e)
+
+    def _coordinate(self, txn: Txn):
+        """Client txn entry: through the ingest pipeline when enabled."""
+        if self.pipeline is not None:
+            return self.pipeline.submit(txn)
+        return self.node.coordinate(txn)
 
     # -------------------------------------------------------------- client --
     def submit(self, read_tokens, appends: Dict[int, int]) -> SubmitResult:
@@ -353,7 +436,7 @@ class TcpHost:
         def run():
             try:
                 txn = _build_list_txn(read_tokens, appends)
-                self.node.coordinate(txn).add_callback(result._complete)
+                self._coordinate(txn).add_callback(result._complete)
             except BaseException as e:  # noqa: BLE001 — the client must see
                 result._complete(None, e)  # the real error, not a timeout
 

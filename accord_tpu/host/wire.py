@@ -44,8 +44,10 @@ _MODULES = [
     "accord_tpu.messages.durability",
     "accord_tpu.messages.epoch",
     "accord_tpu.messages.maxconflict",
+    "accord_tpu.messages.multi",
     "accord_tpu.impl.list_store",
     "accord_tpu.coordinate.errors",
+    "accord_tpu.pipeline.backpressure",
     "accord_tpu.utils.interval_map",
 ]
 

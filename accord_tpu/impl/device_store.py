@@ -436,6 +436,11 @@ class DeviceCommandStore(CommandStore):
         self.plan_waves = plan_waves  # A/B toggle (measure_device.py)
         self._window: List[Tuple[PreLoadContext, object, object]] = []
         self._flush_scheduled = False
+        # >0 while a batch envelope (messages/multi.MultiPreAccept) is
+        # applying its parts: deliveries accumulate without scheduling a
+        # flush, so the WHOLE envelope resolves as one fused probe window
+        # regardless of flush_window_us (the ingest pipeline's contract)
+        self._flush_hold = 0
         self._precomputed: Dict[Tuple[Timestamp, KindSet], _Probe] = {}
         self._precomputed_recovery: Dict[TxnId, _RecoveryProbe] = {}
         self._precomputed_ranges: Dict[Tuple[Timestamp, KindSet],
@@ -453,6 +458,12 @@ class DeviceCommandStore(CommandStore):
         self.device_batches = 0
         self.device_batched_probes = 0
         self.device_max_batch = 0
+        # windows whose operations span >1 distinct transaction — the
+        # cross-transaction batching the ingest pipeline exists to create
+        # (per-txn dispatch yields single-txn windows on the wall-clock
+        # hosts; a MultiPreAccept envelope fuses its whole batch)
+        self.device_cross_txn_windows = 0
+        self.device_window_txn_max = 0
         self.device_recovery_hits = 0
         self.device_recovery_misses = 0
         self.device_wave_batches = 0    # windows with a wave plan
@@ -489,7 +500,7 @@ class DeviceCommandStore(CommandStore):
             super()._submit(context, fn, result)
             return
         self._window.append((context, fn, result))
-        if not self._flush_scheduled:
+        if not self._flush_scheduled and self._flush_hold == 0:
             self._flush_scheduled = True
             if self.flush_window_us > 0:
                 self.node.scheduler.once(self.flush_window_us / 1e6,
@@ -497,11 +508,37 @@ class DeviceCommandStore(CommandStore):
             else:
                 self.node.scheduler.now(self._flush)
 
+    # ----------------------------------------------- envelope window pins --
+    def hold_flush(self) -> None:
+        """Pin the flush window open (batch envelope applying its parts)."""
+        self._flush_hold += 1
+
+    def release_flush(self) -> None:
+        self._flush_hold -= 1
+        if self._flush_hold == 0 and self._window \
+                and not self._flush_scheduled:
+            # flush the pinned accumulation now — the envelope already
+            # bounded the window; adding the flush delay on top would tax
+            # latency twice
+            self._flush_scheduled = True
+            self.node.scheduler.now(self._flush)
+
     def _flush(self) -> None:
         self._flush_scheduled = False
+        if self._flush_hold > 0:
+            # a pre-hold timer fired mid-envelope: defer — release_flush
+            # reschedules with the full envelope accumulated
+            return
         window, self._window = self._window, []
         if not window:
             return
+        window_txns: Set[TxnId] = set()
+        for context, _fn, _result in window:
+            window_txns.update(context.txn_ids)
+        if len(window_txns) > 1:
+            self.device_cross_txn_windows += 1
+        self.device_window_txn_max = max(self.device_window_txn_max,
+                                         len(window_txns))
         plan = None
         if not self.device_disabled:
             try:

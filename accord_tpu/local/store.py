@@ -585,6 +585,17 @@ class CommandStore:
         elif result is not None:
             result.set_success(value)
 
+    # -- flush-window pinning (batch envelopes) --
+    # A MultiPreAccept envelope (messages/multi.py) pins every store's
+    # flush window while its parts apply, so a batching store resolves the
+    # whole envelope as ONE fused window.  The base store runs inline and
+    # has no window: no-ops.  (DeviceCommandStore implements them.)
+    def hold_flush(self) -> None:
+        pass
+
+    def release_flush(self) -> None:
+        pass
+
     def update_ranges(self, ranges: Ranges, unsafe: Ranges = None) -> None:
         """Add the current epoch's assignment. Serving ranges only GROW (the
         reference's per-epoch RangesForEpoch, CommandStore.java:96): old-epoch

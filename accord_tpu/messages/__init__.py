@@ -8,4 +8,5 @@ from accord_tpu.messages.accept import Accept, AcceptOk, AcceptNack
 from accord_tpu.messages.commit import Commit, CommitInvalidate
 from accord_tpu.messages.apply_msg import Apply, ApplyReply
 from accord_tpu.messages.invalidate_msg import BeginInvalidation, InvalidateReply
+from accord_tpu.messages.multi import MultiPreAccept
 from accord_tpu.messages.read import ReadTxnData, ReadOk, ReadNack

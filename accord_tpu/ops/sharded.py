@@ -25,7 +25,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.5 exposes it under experimental only, and
+    # its replication checker lacks a rule for while_loop (the wavefront
+    # fixpoint) — disable the check, it's a static verifier not a semantic
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *args, **kwargs):
+        kwargs.setdefault("check_rep", False)
+        return _shard_map(f, *args, **kwargs)
 
 from accord_tpu.local.cfk import CommandsForKey
 from accord_tpu.ops.encode import (BatchEncoder, STATUS_INACTIVE, _pad_to,

@@ -66,7 +66,9 @@ class BurnRun:
                  partitions: bool = False,
                  partition_period_s: float = 8.0,
                  clock_drift: bool = False,
-                 trace: bool = False):
+                 trace: bool = False,
+                 pipeline: bool = False,
+                 pipeline_config=None):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -81,7 +83,8 @@ class BurnRun:
             rf=rf, progress_log_factory=progress_log_factory,
             num_command_stores=num_command_stores,
             store_factory=store_factory, clock_drift=clock_drift,
-            trace=trace)
+            trace=trace, pipeline=pipeline,
+            pipeline_config=pipeline_config)
         if drop_prob > 0:
             self.cluster.network.default_link = LinkConfig(
                 deliver_prob=1.0 - drop_prob)
@@ -170,7 +173,7 @@ class BurnRun:
             txn = self._gen_txn()
             origin = self.rng.pick(sorted(cluster.nodes))
             start_us = cluster.queue.clock.now_us
-            result = cluster.node(origin).coordinate(txn)
+            result = cluster.pipeline_submit(origin, txn)
 
             def done(value, failure):
                 inflight[0] -= 1
@@ -317,6 +320,9 @@ def main(argv=None) -> int:
                         help="device-store flush window (virtual us; 300 "
                              "measured best — see BASELINE.md latency-tax "
                              "table)")
+    parser.add_argument("--pipeline", action="store_true",
+                        help="submit through the continuous micro-batching "
+                             "ingest pipeline (accord_tpu/pipeline/)")
     parser.add_argument("--range-heavy", action="store_true",
                         help="range reads ~1 in 3 ops instead of 1 in 8")
     parser.add_argument("--message-stats", action="store_true",
@@ -370,7 +376,7 @@ def main(argv=None) -> int:
                       store_factory=store_factory,
                       num_command_stores=args.stores,
                       partitions=args.partitions, clock_drift=args.drift,
-                      trace=args.trace)
+                      trace=args.trace, pipeline=args.pipeline)
         stats = run.run()
         if args.trace:
             for node in run.cluster.nodes.values():
@@ -381,7 +387,7 @@ def main(argv=None) -> int:
         if args.device_store or args.mesh_store:
             h = m = b = p = rh = rm = dis = 0
             wb = wp = wx = wd = gh = gm = 0
-            mx = 0
+            mx = xw = 0
             for node in run.cluster.nodes.values():
                 for s in node.command_stores.all():
                     h += s.device_hits
@@ -397,14 +403,23 @@ def main(argv=None) -> int:
                     wd = max(wd, s.device_wave_max_depth)
                     gh += s.device_range_hits
                     gm += s.device_range_misses
+                    xw += s.device_cross_txn_windows
                     dis += s.device_disabled
             extra = (f" device[hits={h} misses={m} batches={b} "
-                     f"probes={p} max_batch={mx} "
+                     f"probes={p} max_batch={mx} cross_txn_windows={xw} "
                      f"recovery_hits={rh} recovery_misses={rm} "
                      f"wave_batches={wb} wave_planned={wp} "
                      f"wave_executed={wx} wave_depth={wd} "
                      f"range_hits={gh} range_misses={gm}"
                      + (f" DISABLED={dis}" if dis else "") + "]")
+        if run.cluster.pipelines:
+            ps = [p.stats for p in run.cluster.pipelines.values()]
+            extra += (f" pipeline[batches={sum(s.batches for s in ps)} "
+                      f"dispatched={sum(s.dispatched for s in ps)} "
+                      f"shed={sum(s.shed for s in ps)} "
+                      f"batch_max={max(s.batch_size_max for s in ps)} "
+                      f"batch_mean="
+                      f"{sum(s.dispatched for s in ps) / max(1, sum(s.batches for s in ps)):.1f}]")
         inf = {"evidence": 0, "quorum_evidence": 0, "inferred_rounds": 0}
         for node in run.cluster.nodes.values():
             for k in inf:

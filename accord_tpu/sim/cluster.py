@@ -81,7 +81,8 @@ class SimCluster:
                  progress_log_factory: Optional[Callable] = None,
                  store_factory: Optional[Callable] = None,
                  clock_drift: bool = False, journal: bool = True,
-                 trace: bool = False):
+                 trace: bool = False, pipeline: bool = False,
+                 pipeline_config=None):
         self.random = RandomSource(seed)
         self.queue = PendingQueue(self.random.fork())
         self.network = SimNetwork(self.queue, self.random.fork())
@@ -125,6 +126,24 @@ class SimCluster:
             service.attach_node(node)
             self.config_services[nid] = service
             service.report_topology(self.topology)
+        # continuous micro-batching ingest (accord_tpu/pipeline/) on every
+        # node, deadline-driven by the shared virtual-time scheduler so the
+        # deterministic burn can exercise admission batching, MultiPreAccept
+        # envelopes and load shedding under the full nemesis stack
+        self.pipelines: Dict[int, object] = {}
+        if pipeline:
+            from accord_tpu.pipeline import Pipeline
+            for nid, node in self.nodes.items():
+                self.pipelines[nid] = Pipeline(node, self.scheduler,
+                                               pipeline_config)
+
+    def pipeline_submit(self, node_id: int, txn):
+        """Client entry through the node's ingest pipeline (falls back to
+        direct coordination when the pipeline is off)."""
+        p = self.pipelines.get(node_id)
+        if p is None:
+            return self.nodes[node_id].coordinate(txn)
+        return p.submit(txn)
 
     def _make_topology(self, epoch: int, node_ids: List[int], n_shards: int,
                        rf: int) -> Topology:

@@ -191,13 +191,21 @@ class NodeSink(CallbackSink):
         self.network = network
 
     def send(self, to: int, request: Request) -> None:
+        if self._capture(to, None, request):
+            return
         self.network.deliver_request(self.node_id, to, request, None)
 
     def send_with_callback(self, to: int, request: Request, callback,
                            executor=None) -> None:
         msg_id = self._register(callback)
+        ctx = (self.node_id, msg_id)
+        if self._capture(to, ctx, request):
+            return
+        self.network.deliver_request(self.node_id, to, request, ctx)
+
+    def _send_prepared(self, to: int, reply_context, request) -> None:
         self.network.deliver_request(self.node_id, to, request,
-                                     (self.node_id, msg_id))
+                                     reply_context)
 
     def reply(self, to: int, reply_context, reply: Reply) -> None:
         if reply_context is None:

@@ -699,12 +699,17 @@ def bench_maelstrom(nodes=3, keys=100, n_ops=400, single_key=True,
     }))
 
 
-def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16):
+def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16,
+              metric="tcp_host_txn_per_sec", extra_fields=None):
     """BASELINE row: black-box throughput over the REAL-SOCKET transport —
     one OS process (one GIL) per node, inter-node traffic on direct TCP
     connections (no relay bus, unlike the Maelstrom harness where every
     message funnels through the single-threaded stdio router), strict
-    serializability verified post-run.  CPU-only."""
+    serializability verified post-run.  CPU-only.
+
+    The `pipeline` arg is the CLIENT's in-flight depth; with
+    ACCORD_PIPELINE=1 in the environment the node processes additionally
+    run the continuous micro-batching ingest layer (--config pipeline)."""
     import random
 
     from accord_tpu.host.tcp import TcpClusterClient
@@ -793,8 +798,8 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16):
     finally:
         c.close()
     assert acked > 0.9 * n_ops, (acked, completed)
-    emit(dict({
-        "metric": "tcp_host_txn_per_sec",
+    result = {
+        "metric": metric,
         "value": round(acked / dt, 1),
         "unit": "txn/s",
         "workload": "lin-kv read+append mix, direct-socket cluster",
@@ -802,9 +807,44 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16):
         "keys": keys,
         "ops": completed,
         "acked": acked,
+        "client_inflight": pipeline,
         "wall_seconds": round(dt, 2),
         "verified": "strict-serializable",
-    }))
+    }
+    if extra_fields:
+        result.update(extra_fields)
+    emit(result)
+
+
+def bench_pipeline(nodes=3, keys=100, n_ops=400, seed=7):
+    """Satellite of the ingest-pipeline tentpole: the SAME tcp workload and
+    differenced wall-clock discipline, with ACCORD_PIPELINE=1 in every node
+    process — client submissions coalesce into micro-batches (one
+    MultiPreAccept envelope per replica per batch; fused device windows
+    when ACCORD_TCP_DEVICE_STORE=1).  Client in-flight depth is raised to
+    64 so admission pressure actually forms batches at max_batch=8.
+    History lanes: 'pipeline' (scalar stores) / 'pipeline+device', vs the
+    per-txn 'tcp' / 'tcp+device' lanes."""
+    os.environ["ACCORD_PIPELINE"] = "1"
+    os.environ.setdefault("ACCORD_PIPELINE_MAX_BATCH", "8")
+    os.environ.setdefault("ACCORD_PIPELINE_MAX_WAIT_US", "2000")
+    device = os.environ.get("ACCORD_TCP_DEVICE_STORE", "") == "1"
+    per_txn_lane = "tcp+device" if device else "tcp"
+    extra = {
+        "max_batch": int(os.environ["ACCORD_PIPELINE_MAX_BATCH"]),
+        "max_wait_us": int(os.environ["ACCORD_PIPELINE_MAX_WAIT_US"]),
+        "device_store": device,
+    }
+    try:
+        with open(HISTORY_PATH) as f:
+            prev = json.load(f).get(per_txn_lane, {}).get("host")
+        if prev and prev.get("value"):
+            extra["per_txn_baseline"] = {"config": per_txn_lane,
+                                         "value": prev["value"]}
+    except (OSError, ValueError):
+        pass
+    bench_tcp(nodes=nodes, keys=keys, n_ops=n_ops, seed=seed, pipeline=64,
+              metric="pipeline_tcp_host_txn_per_sec", extra_fields=extra)
 
 
 # ---------------------------------------------------------------- tpcc -----
@@ -1152,7 +1192,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="default",
                     choices=["default", "zipf1m", "rangestress", "tpcc",
-                             "maelstrom", "maelstrom-rw", "tcp"])
+                             "maelstrom", "maelstrom-rw", "tcp",
+                             "pipeline"])
     ap.add_argument("--verify", action="store_true",
                     help="cross-check device window counts against a host "
                          "re-derivation (zipf1m)")
@@ -1169,18 +1210,18 @@ def main():
     ns = ap.parse_args()
     JSON_OUT = ns.json_out
     CONFIG = ns.config
-    if ns.config == "tcp" \
+    if ns.config in ("tcp", "pipeline") \
             and os.environ.get("ACCORD_TCP_DEVICE_STORE", "") == "1":
         # device-store host runs get their own regression-history lane:
         # comparing them against scalar-host numbers would flag the mode
         # switch, not a code regression
-        CONFIG = "tcp+device"
+        CONFIG = ns.config + "+device"
     if ns.fill:
         only = set(ns.only.split(",")) if ns.only else None
         missing = fill_device_rows(ns.max_wait, only)
         print(f"# fill done; {missing} configs still missing")
         raise SystemExit(0 if missing == 0 else 1)
-    if ns.config not in ("maelstrom", "maelstrom-rw", "tcp"):
+    if ns.config not in ("maelstrom", "maelstrom-rw", "tcp", "pipeline"):
         # device-using configs probe the (possibly dead-tunneled) backend
         # first; host-only configs never touch the chip
         from accord_tpu.utils.backend import resolve_platform
@@ -1197,6 +1238,8 @@ def main():
         bench_maelstrom(nodes=5, keys=20, single_key=False)
     elif ns.config == "tcp":
         bench_tcp(nodes=3, keys=100)
+    elif ns.config == "pipeline":
+        bench_pipeline(nodes=3, keys=100)
     else:
         bench_rangestress()
 

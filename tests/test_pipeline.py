@@ -1,0 +1,239 @@
+"""Continuous micro-batching ingest pipeline (accord_tpu/pipeline/).
+
+Focused coverage for the tentpole subsystem: admission batching (deadline
+expiry, max-batch closes, adaptive deadlines), bounded-queue load shedding
+with the typed Rejected reply, the MultiPreAccept wire envelope round-trip
+through host/wire.py, and — end to end on the deterministic sim — that
+batching coalesces fan-out into one envelope per replica, fuses device
+windows across the batch's transactions, and never reorders conflicting
+transactions' dependencies within a batch (admission order == witness
+order on every replica).
+"""
+
+import json
+
+import pytest
+
+from accord_tpu.pipeline.backpressure import PipelineStats, Rejected, SendBackoff
+from accord_tpu.pipeline.ingest import IngestQueue, PipelineConfig
+from accord_tpu.sim.queue import PendingQueue
+from accord_tpu.sim.scheduler import SimScheduler
+from accord_tpu.utils.random_source import RandomSource
+
+
+def make_queue(dispatched, **cfg):
+    pq = PendingQueue(RandomSource(1))
+    q = IngestQueue(SimScheduler(pq), dispatched.append,
+                    PipelineConfig(**cfg))
+    return q, pq
+
+
+def drain(pq, max_items=10_000):
+    n = 0
+    while n < max_items and pq.process_one():
+        n += 1
+
+
+class TestIngestQueue:
+    def test_deadline_expiry_closes_partial_batch(self):
+        batches = []
+        q, pq = make_queue(batches, max_batch=8, max_wait_us=2000)
+        r1, r2 = q.submit("t1"), q.submit("t2")
+        assert batches == []  # below max_batch: parked on the deadline
+        drain(pq)  # virtual time advances past the deadline timer
+        assert len(batches) == 1
+        assert [a.txn for a in batches[0]] == ["t1", "t2"]
+        assert q.stats.deadline_closes == 1 and q.stats.size_closes == 0
+        assert not r1.is_done and not r2.is_done  # settled by coordination
+
+    def test_max_batch_closes_immediately(self):
+        batches = []
+        q, pq = make_queue(batches, max_batch=4, max_wait_us=1_000_000)
+        for i in range(4):
+            q.submit(i)
+        # closed synchronously on the 4th admit — no timer wait
+        assert len(batches) == 1 and len(batches[0]) == 4
+        assert q.stats.size_closes == 1
+        assert [a.txn for a in batches[0]] == [0, 1, 2, 3]  # admission order
+
+    def test_oversize_backlog_drains_as_full_batches(self):
+        batches = []
+        q, pq = make_queue(batches, max_batch=3, max_wait_us=100)
+        for i in range(3):
+            q.submit(i)
+        assert len(batches) == 1
+        q.submit(3)
+        drain(pq)  # deadline fires for the remainder
+        assert len(batches) == 2 and [a.txn for a in batches[1]] == [3]
+
+    def test_load_shed_typed_rejected(self):
+        batches = []
+        q, pq = make_queue(batches, max_batch=16, max_wait_us=1_000_000,
+                           max_queue=2)
+        r1, r2, r3 = q.submit(1), q.submit(2), q.submit(3)
+        assert not r1.is_done and not r2.is_done
+        assert r3.is_done and isinstance(r3.failure(), Rejected)
+        assert q.stats.shed == 1 and q.stats.admitted == 2
+        assert batches == []  # shedding never dispatches
+
+    def test_adaptive_deadline_shrinks_with_depth(self):
+        q, _ = make_queue([], max_batch=8, max_wait_us=8000, adaptive=True)
+        waits = [q.effective_wait_us(d) for d in (1, 4, 8)]
+        assert waits[0] == 8000          # lone txn: full window
+        assert waits[0] > waits[1] > waits[2]
+        assert waits[2] >= 8000 // 8     # floored, never zero
+        q2, _ = make_queue([], max_batch=8, max_wait_us=8000, adaptive=False)
+        assert q2.effective_wait_us(8) == 8000
+
+    def test_stats_snapshot(self):
+        batches = []
+        q, pq = make_queue(batches, max_batch=2, max_wait_us=100)
+        q.submit(1), q.submit(2)
+        snap = q.stats.snapshot()
+        assert snap["batches"] == 1 and snap["dispatched"] == 2
+        assert snap["batch_size_max"] == 2
+
+
+class TestSendBackoff:
+    def test_schedule_grows_then_drops(self):
+        b = SendBackoff(base_s=0.05, cap_s=1.0, max_attempts=4)
+        delays = [b.delay_s(a) for a in (1, 2, 3, 4)]
+        assert delays[:3] == [0.05, 0.1, 0.2]
+        assert delays[3] is None  # exhausted: drop the frame
+
+    def test_cap(self):
+        b = SendBackoff(base_s=0.5, cap_s=0.6, max_attempts=10)
+        assert b.delay_s(5) == 0.6
+
+
+class TestMultiPreAcceptWire:
+    def _parts(self):
+        from accord_tpu.messages.preaccept import PreAccept
+        from accord_tpu.primitives.keys import Keys, Route, RoutingKeys
+        from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+        from accord_tpu.primitives.txn import Txn
+        from accord_tpu.impl.list_store import ListQuery, ListRead
+
+        parts = []
+        for hlc, ctx in ((9, 17), (10, (3, 18)), (11, None)):
+            t = TxnId.create(1, hlc, TxnKind.WRITE, Domain.KEY, 1)
+            keys = RoutingKeys.of(1, 2)
+            route = Route(keys[0], keys=keys)
+            txn = Txn(TxnKind.READ, Keys.of(1, 2),
+                      read=ListRead(Keys.of(1)), query=ListQuery())
+            scope = route.slice(route.covering())
+            part_txn = txn.slice(scope.covering(), include_query=False)
+            parts.append((ctx, PreAccept(t, part_txn, scope, 1,
+                                         full_route=route)))
+        return parts
+
+    def test_roundtrip_through_wire(self):
+        """The envelope must survive host/wire.py with every reply-context
+        shape the transports mint (int msg-id, sim (origin, msg_id) tuple,
+        None for callback-less sends)."""
+        from accord_tpu.host.wire import decode_message, encode_message
+        from accord_tpu.messages.multi import MultiPreAccept
+
+        env = MultiPreAccept(self._parts())
+        blob = json.dumps(encode_message(env))
+        back = decode_message(json.loads(blob))
+        assert isinstance(back, MultiPreAccept)
+        assert len(back.parts) == 3
+        for (ctx_a, req_a), (ctx_b, req_b) in zip(env.parts, back.parts):
+            assert ctx_a == ctx_b
+            assert req_a.txn_id == req_b.txn_id
+            assert req_a.scope == req_b.scope
+        assert back.wait_for_epoch == 0  # parts gate individually
+
+    def test_rejected_is_wire_typed(self):
+        """A shed reply crossing the wire must decode back to Rejected, not
+        an anonymous RuntimeError — clients distinguish retry-safe sheds
+        from protocol failures by type."""
+        from accord_tpu.host.wire import decode_message, encode_message
+
+        back = decode_message(json.loads(json.dumps(
+            encode_message(Rejected("queue full")))))
+        assert isinstance(back, Rejected)
+        assert "queue full" in str(back)
+
+
+class TestPipelineSim:
+    """End-to-end over the deterministic sim cluster."""
+
+    def _append_txn(self, token, value):
+        from accord_tpu.impl.list_store import (ListQuery, ListRead,
+                                                ListUpdate)
+        from accord_tpu.primitives.keys import Key, Keys
+        from accord_tpu.primitives.timestamp import TxnKind
+        from accord_tpu.primitives.txn import Txn
+
+        return Txn(TxnKind.WRITE, Keys.of(token),
+                   read=ListRead(Keys.of(token)), query=ListQuery(),
+                   update=ListUpdate({Key(token): value}))
+
+    def test_batch_preserves_conflicting_txn_order(self):
+        """Four conflicting appends admitted as ONE batch must commit in
+        admission order: the batch coordinator starts coordinations in
+        admission order with monotonically minted txn ids, so on the
+        uncontended fast path each later txn witnesses every earlier one —
+        batching coalesces delivery, it never reorders dependencies."""
+        from accord_tpu.primitives.keys import Key
+        from accord_tpu.sim.cluster import SimCluster
+
+        cluster = SimCluster(n_nodes=3, seed=5, pipeline=True,
+                             pipeline_config=PipelineConfig(
+                                 max_batch=4, max_wait_us=1_000_000))
+        token = 7
+        results = [cluster.pipeline_submit(
+            1, self._append_txn(token, v)) for v in range(4)]
+        p = cluster.pipelines[1]
+        assert p.stats.batches == 1 and p.stats.batch_size_max == 4
+        cluster.process_until(lambda: all(r.is_done for r in results),
+                              max_items=2_000_000)
+        for r in results:
+            assert r.failure() is None, r.failure()
+        # one MultiPreAccept envelope per replica carried the whole batch
+        delivered = cluster.network.stats.get("deliver.MultiPreAccept", 0)
+        assert delivered >= 1, cluster.network.stats
+        # let trailing Apply propagation drain before reading replicas
+        cluster.queue.drain(until_us=cluster.queue.clock.now_us + 60_000_000,
+                            max_items=2_000_000)
+        # admission order == execution order on the fast path
+        for node in cluster.nodes.values():
+            history = node.data_store.get(Key(token))
+            assert tuple(history) == (0, 1, 2, 3), history
+
+    def test_burn_with_pipeline_and_device_store_fuses_windows(self):
+        """Pipeline + batched device tier (verify=True: every served scan
+        inline-certified against the scalar oracle): batch envelopes must
+        produce CROSS-transaction fused probe windows, the thing per-txn
+        dispatch cannot."""
+        from accord_tpu.impl.device_store import DeviceCommandStore
+        from accord_tpu.sim.burn import BurnRun
+
+        run = BurnRun(7, 60, pipeline=True,
+                      store_factory=DeviceCommandStore.factory(
+                          flush_window_us=200, verify=True))
+        stats = run.run()
+        assert stats.acks > 0
+        assert stats.lost == 0 and stats.pending == 0
+        stores = [s for node in run.cluster.nodes.values()
+                  for s in node.command_stores.all()]
+        assert sum(s.device_hits for s in stores) > 0
+        assert sum(s.device_cross_txn_windows for s in stores) > 0, \
+            "no cross-transaction window was fused: batching is inert"
+        ps = [p.stats for p in run.cluster.pipelines.values()]
+        assert sum(s.batches for s in ps) > 0
+        assert sum(s.shed for s in ps) == 0
+
+    def test_burn_pipeline_plain_stores(self):
+        """Pipeline over plain scalar stores: the envelope path must be a
+        pure transport optimization (all three checkers green, no loss)."""
+        from accord_tpu.sim.burn import BurnRun
+
+        run = BurnRun(11, 80, pipeline=True)
+        stats = run.run()
+        assert stats.acks > 0
+        assert stats.lost == 0 and stats.pending == 0
+        ps = [p.stats for p in run.cluster.pipelines.values()]
+        assert sum(s.dispatched for s in ps) > 0
