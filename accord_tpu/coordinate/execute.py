@@ -51,6 +51,8 @@ class Propose(Callback):
 
     def start(self) -> None:
         def ready():
+            self.node.obs.txn_phase(self.txn_id, "accept",
+                                    ballot=repr(self.ballot))
             topologies = self.node.topology.with_unsynced_epochs(
                 self.route.participants(), self.txn_id.epoch,
                 self.execute_at.epoch)
@@ -132,6 +134,7 @@ class Stabilise(Callback):
 
     def start(self) -> None:
         def ready():
+            self.node.obs.txn_phase(self.txn_id, "commit")
             topologies = self.node.topology.with_unsynced_epochs(
                 self.route.participants(), self.txn_id.epoch,
                 self.execute_at.epoch)
@@ -207,6 +210,7 @@ class ExecutePath(Callback):
     def _start(self) -> None:
         from accord_tpu.coordinate.read_coord import ReadCoordinator
         from accord_tpu.topology.topologies import Topologies
+        self.node.obs.txn_phase(self.txn_id, "stable")
         execute_epoch = self.execute_at.epoch
         topologies = self.node.topology.with_unsynced_epochs(
             self.route.participants(), self.txn_id.epoch, execute_epoch)
@@ -317,6 +321,7 @@ class ExecutePath(Callback):
             self._persist()
 
     def _persist(self) -> None:
+        self.node.obs.txn_phase(self.txn_id, "apply")
         writes = self.txn.execute(self.txn_id, self.execute_at, self.read_data)
         result = (self.txn.result(self.txn_id, self.execute_at, self.read_data)
                   if self.txn.query is not None else None)

@@ -58,6 +58,8 @@ class Recover(Callback):
         # that was never required to consult that electorate — recovery then
         # invalidates a committed transaction (found by a 2000-op soak burn
         # under loss + topology churn).
+        self.node.obs.txn_phase(self.txn_id, "begin_recover",
+                                ballot=repr(self.ballot))
         topologies = self.node.topology.precise_epochs(
             self.route.participants(), self.txn_id.epoch, self.txn_id.epoch)
         self.tracker = RecoveryTracker(topologies)

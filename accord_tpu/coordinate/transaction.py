@@ -57,6 +57,7 @@ class CoordinateTransaction(Callback):
     # ------------------------------------------------------------ preaccept --
     def start(self) -> None:
         self.route = self.node.compute_route(self.txn)
+        self.node.obs.txn_phase(self.txn_id, "preaccept")
         self.topologies = self.node.topology.with_unsynced_epochs(
             self.route.participants(), self.txn_id.epoch, self.txn_id.epoch)
         self.tracker = FastPathTracker(self.topologies)
@@ -111,6 +112,7 @@ class CoordinateTransaction(Callback):
             # fast path: execute at the original timestamp (fast-path votes
             # are witnessed_at == txnId, so no epoch extension can apply)
             self.node.events.on_fast_path_taken(self.txn_id)
+            self.node.obs.txn_path(self.txn_id, "fast")
             self._execute(CommitKind.STABLE_FAST_PATH,
                           self.txn_id.as_timestamp(),
                           Deps.merge([ok.deps for ok in oks]))
@@ -131,6 +133,7 @@ class CoordinateTransaction(Callback):
                 self._extend_epochs(max_witnessed.epoch)
                 return
             self.node.events.on_slow_path_taken(self.txn_id)
+            self.node.obs.txn_path(self.txn_id, "slow")
             merged_deps = Deps.merge([ok.deps for ok in oks])
             Propose(self.node, self.txn_id, self.txn, self.route, Ballot.ZERO,
                     max_witnessed, merged_deps,
@@ -198,6 +201,7 @@ class _ExtraEpochRound(Callback):
 
     def start(self) -> None:
         p = self.parent
+        p.node.obs.txn_phase(p.txn_id, "preaccept_extend")
         for to in self.topologies.nodes():
             scope = TxnRequest.compute_scope(to, self.topologies, p.route)
             if scope is None:

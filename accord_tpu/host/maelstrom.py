@@ -136,6 +136,7 @@ class MaelstromHost:
         self.rf = rf
         self.node = None
         self.pipeline = None  # built with the node when ACCORD_PIPELINE=1
+        self.metrics_server = None  # built with the node (obs/httpd)
         self.node_name = ""
         self.names: Dict[int, str] = {}
         self.scheduler = RealTimeScheduler()
@@ -176,6 +177,11 @@ class MaelstromHost:
         self.pipeline = Pipeline(self.node, self.scheduler,
                                  PipelineConfig.from_env()) \
             if pipeline_enabled() else None
+        # ACCORD_METRICS_PORT=<base>: per-process Prometheus/JSON metrics
+        # endpoint (base + node_id - 1), same layer the TCP host exposes
+        from accord_tpu.obs.httpd import maybe_start_from_env
+        self.metrics_server = maybe_start_from_env(lambda: self.node.obs,
+                                                   node_id=my_id)
 
     # ------------------------------------------------------------ handlers --
     def handle(self, envelope: dict) -> None:

@@ -30,6 +30,7 @@ from accord_tpu.host.maelstrom import (HostAgent, MaelstromSink,
 from accord_tpu.host.rt import RealTimeScheduler
 from accord_tpu.host.wire import decode_message, encode_message
 from accord_tpu.impl.list_store import ListQuery, ListRead, ListStore, ListUpdate
+from accord_tpu.obs.views import MetricView, bind_metric_views
 from accord_tpu.primitives.keys import Key, Keys
 from accord_tpu.primitives.timestamp import TxnKind
 from accord_tpu.primitives.txn import Txn
@@ -112,7 +113,14 @@ class _PeerWriter:
     send is retried with exponential backoff (reconnecting between
     attempts) before the frame is finally dropped — transient stalls no
     longer cost a frame, while a genuinely dead peer still degrades to the
-    lossy-link model (RPC timeouts and the progress log heal)."""
+    lossy-link model (RPC timeouts and the progress log heal).
+
+    shed/send_drops/retries are registry-backed views (obs/) labeled per
+    peer; the in-flight depth is a gauge the metrics endpoint exposes."""
+
+    shed = MetricView("accord_tcp_peer_shed_total")
+    send_drops = MetricView("accord_tcp_peer_send_drops_total")
+    retries = MetricView("accord_tcp_peer_retries_total")
 
     def __init__(self, host: "TcpHost", to: int):
         from accord_tpu.pipeline.backpressure import SendBackoff
@@ -122,9 +130,10 @@ class _PeerWriter:
         self.queue: "queue.Queue" = queue.Queue(maxsize=max_inflight)
         self.inflight = threading.BoundedSemaphore(max_inflight)
         self.backoff = SendBackoff()
-        self.shed = 0       # frames dropped at enqueue (peer lane full)
-        self.send_drops = 0  # frames dropped after exhausting retries
-        self.retries = 0
+        registry = host.node.obs.registry
+        bind_metric_views(self, registry, peer=to)
+        self._g_inflight = registry.gauge("accord_tcp_peer_inflight",
+                                          peer=to)
         self.sock: Optional[socket.socket] = None
         threading.Thread(target=self._drain, daemon=True).start()
 
@@ -134,6 +143,7 @@ class _PeerWriter:
             return
         try:
             self.queue.put_nowait(frame)
+            self._g_inflight.value = self.queue.qsize()
         except queue.Full:  # unreachable (semaphore == queue bound); belt
             self.inflight.release()
             self.shed += 1
@@ -148,6 +158,7 @@ class _PeerWriter:
                 self._send_with_retry(frame)
             finally:
                 self.inflight.release()
+                self._g_inflight.value = self.queue.qsize()
 
     def _send_with_retry(self, frame: dict) -> None:
         attempt = 0
@@ -266,6 +277,12 @@ class TcpHost:
                                  PipelineConfig.from_env()) \
             if pipeline_enabled() else None
 
+        # ACCORD_METRICS_PORT=<base>: Prometheus text + JSON snapshot on
+        # base + node_id - 1 (per-process port offset); 0 = ephemeral
+        from accord_tpu.obs.httpd import maybe_start_from_env
+        self.metrics_server = maybe_start_from_env(lambda: self.node.obs,
+                                                   node_id=my_id)
+
         threading.Thread(target=self._accept_loop, daemon=True).start()
         self.loop_thread = threading.Thread(target=self._run, daemon=True)
         self.loop_thread.start()
@@ -380,6 +397,14 @@ class TcpHost:
             # client txn over the wire (multi-process bench/harness path)
             self._client_submit(from_id, body)
             return
+        if kind == "metrics":
+            # harness/client JSON snapshot fetch (bench.py records these
+            # alongside its BENCH_HISTORY rows); client-endpoint src only
+            if from_id <= 0:
+                self.emit(from_id, {"type": "metrics_reply",
+                                    "req": body.get("req"),
+                                    "snapshot": self.node.obs.snapshot()})
+            return
         if kind == "stop":
             # accept stop only from harness/client frames (non-positive
             # declared src).  NOTE: src is self-declared — this guards
@@ -445,6 +470,11 @@ class TcpHost:
 
     def close(self) -> None:
         self.running = False
+        if self.metrics_server is not None:
+            try:
+                self.metrics_server.shutdown()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
         try:
             self.server.close()
         except OSError:
@@ -537,6 +567,26 @@ class TcpClusterClient:
             return self.inbox.get(timeout=timeout_s)
         except queue.Empty:
             return None
+
+    def fetch_metrics(self, to: int, timeout_s: float = 15.0
+                      ) -> Optional[dict]:
+        """Pull node `to`'s obs snapshot over the frame transport (use only
+        when no submit replies are outstanding — stray frames between the
+        request and its reply are consumed and dropped)."""
+        req = f"metrics-{to}"
+        try:
+            self._send(to, {"type": "metrics", "req": req})
+        except OSError:
+            return None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            frame = self.recv(min(1.0, timeout_s))
+            if frame is None:
+                continue
+            body = frame.get("body", {})
+            if body.get("type") == "metrics_reply" and body.get("req") == req:
+                return body.get("snapshot")
+        return None
 
     def close(self) -> None:
         for i in range(1, len(self.procs) + 1):

@@ -42,6 +42,7 @@ import numpy as np
 
 from accord_tpu.local.store import (CommandStore, PreLoadContext,
                                     SafeCommandStore)
+from accord_tpu.obs.views import CounterDict, MetricView, bind_metric_views
 from accord_tpu.primitives.keys import Key, Keys, Ranges
 from accord_tpu.primitives.timestamp import KindSet, Timestamp, TxnId
 
@@ -425,7 +426,41 @@ class DeviceCommandStore(CommandStore):
     `_submit` defers operations; a zero-delay (or `flush_window_us`-delayed)
     scheduler event drains the window: one batched kernel call precomputes
     every declared deps probe, then the operations run serially.
+
+    The `device_*` counters live in the node's metrics registry (obs/) —
+    the attribute names below are read-through views (obs/views.MetricView)
+    so the burn/measure harnesses and the `+=` call sites are unchanged.
     """
+
+    device_hits = MetricView("accord_device_hits_total")
+    device_misses = MetricView("accord_device_misses_total")
+    device_batches = MetricView("accord_device_kernel_batches_total")
+    device_batched_probes = MetricView("accord_device_batched_probes_total")
+    device_max_batch = MetricView("accord_device_max_batch", kind="gauge")
+    # flush-window accounting: every drained window, plus the
+    # cross-transaction fusion the ingest pipeline exists to create
+    device_flush_windows = MetricView("accord_device_flush_windows_total")
+    device_cross_txn_windows = MetricView(
+        "accord_device_cross_txn_windows_total")
+    device_window_txn_max = MetricView("accord_device_window_txn_max",
+                                       kind="gauge")
+    device_recovery_hits = MetricView("accord_device_recovery_hits_total")
+    device_recovery_misses = MetricView(
+        "accord_device_recovery_misses_total")
+    device_wave_batches = MetricView("accord_device_wave_batches_total")
+    device_wave_planned = MetricView("accord_device_wave_planned_total")
+    device_wave_executed = MetricView("accord_device_wave_executed_total")
+    device_wave_max_depth = MetricView("accord_device_wave_max_depth",
+                                       kind="gauge")
+    device_range_hits = MetricView("accord_device_range_hits_total")
+    device_range_misses = MetricView("accord_device_range_misses_total")
+    device_range_batches = MetricView("accord_device_range_batches_total")
+    device_range_candidates = MetricView(
+        "accord_device_range_candidates_total")
+    # compile-count hook: jit caches per argument-shape tuple, so the
+    # first window at a NEW encoded shape pays an XLA compile — counting
+    # distinct shapes counts compiles without touching jax internals
+    device_compile_shapes = MetricView("accord_device_compile_shapes_total")
 
     def __init__(self, store_id: int, node, ranges, *,
                  flush_window_us: int = 0, verify: bool = False,
@@ -448,32 +483,21 @@ class DeviceCommandStore(CommandStore):
         # (range_version, ids, intervals, dev_starts, dev_ends) — the
         # encoded range index, reused across windows until a mutation
         self._range_index_cache = None
-        self.device_hits = 0
-        self.device_misses = 0
+        registry = getattr(getattr(node, "obs", None), "registry", None)
+        if registry is None:  # bare-store harnesses without a full Node
+            from accord_tpu.obs.registry import Registry
+            registry = Registry()
+        bind_metric_views(self, registry, store=store_id)
         # miss-cause breakdown for the deps arm (hit-rate diagnosis):
         # no_probe (nothing precomputed at this (before, kinds)), version
         # (gate tripped), key_cover (probe didn't cover a queried key)
-        self.device_miss_causes = {"no_probe": 0, "version": 0,
-                                   "key_cover": 0}
-        self.device_batches = 0
-        self.device_batched_probes = 0
-        self.device_max_batch = 0
-        # windows whose operations span >1 distinct transaction — the
-        # cross-transaction batching the ingest pipeline exists to create
-        # (per-txn dispatch yields single-txn windows on the wall-clock
-        # hosts; a MultiPreAccept envelope fuses its whole batch)
-        self.device_cross_txn_windows = 0
-        self.device_window_txn_max = 0
-        self.device_recovery_hits = 0
-        self.device_recovery_misses = 0
-        self.device_wave_batches = 0    # windows with a wave plan
-        self.device_wave_planned = 0    # applies scheduled by the kernel
-        self.device_wave_executed = 0   # planned applies that ran in-window
-        self.device_wave_max_depth = 0
-        self.device_range_hits = 0      # range arms served from the stab
-        self.device_range_misses = 0    # (counted only when work existed)
-        self.device_range_batches = 0
-        self.device_range_candidates = 0
+        self.device_miss_causes = CounterDict(
+            registry, "accord_device_miss_causes_total",
+            ("no_probe", "version", "key_cover"), label="cause",
+            store=store_id)
+        self._h_window_txns = registry.histogram(
+            "accord_device_window_txns", store=store_id)
+        self._seen_shapes = set()  # encoded-shape buckets (compile count)
         # set when the device backend dies mid-run (e.g. the TPU tunnel
         # drops): the store keeps serving every scan through the scalar
         # path instead of crashing the node
@@ -508,6 +532,13 @@ class DeviceCommandStore(CommandStore):
             else:
                 self.node.scheduler.now(self._flush)
 
+    def _note_compile_shape(self, *shapes) -> None:
+        """First sighting of an encoded-shape bucket == one XLA compile of
+        the kernel at that shape (jit caches per shape tuple)."""
+        if shapes not in self._seen_shapes:
+            self._seen_shapes.add(shapes)
+            self.device_compile_shapes += 1
+
     # ----------------------------------------------- envelope window pins --
     def hold_flush(self) -> None:
         """Pin the flush window open (batch envelope applying its parts)."""
@@ -535,6 +566,8 @@ class DeviceCommandStore(CommandStore):
         window_txns: Set[TxnId] = set()
         for context, _fn, _result in window:
             window_txns.update(context.txn_ids)
+        self.device_flush_windows += 1
+        self._h_window_txns.observe(len(window_txns))
         if len(window_txns) > 1:
             self.device_cross_txn_windows += 1
         self.device_window_txn_max = max(self.device_window_txn_max,
@@ -636,6 +669,7 @@ class DeviceCommandStore(CommandStore):
         cfks, versions, committed_versions = self._probe_snapshots(probes)
         enc = BatchEncoder.for_probes(cfks, probes)
         s, b = enc.state, enc.dbatch
+        self._note_compile_shape(s.entry_rank.shape, b.touches.shape)
         dep_mask, _count = batched_active_deps(
             s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
             s.entry_kind, b.txn_rank, b.txn_witness_mask, b.touches)
@@ -960,6 +994,8 @@ class MeshDeviceCommandStore(DeviceCommandStore):
         enc = ShardedEncoder.for_probes(cfks, probes,
                                         n_shards=self._mesh_shards, pad=PAD)
         args = enc.args()
+        self._note_compile_shape(*(getattr(a, "shape", None)
+                                   for a in args[:7]))
         dep_mask, _count = self._sharded_step(
             *args[:5], args[5], args[6], args[8])
         keyed = enc.decode_key_deps(np.asarray(dep_mask))

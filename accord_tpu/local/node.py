@@ -16,6 +16,7 @@ from accord_tpu.api.spi import (
 )
 from accord_tpu.coordinate.errors import Timeout
 from accord_tpu.local.store import CommandStores, PreLoadContext
+from accord_tpu.obs.spans import trace_key as _trace_key
 from accord_tpu.messages.base import Callback, FailureReply, Reply, Request, TxnRequest
 from accord_tpu.primitives.keys import Keys, Ranges, Route, RoutingKey
 from accord_tpu.primitives.timestamp import Domain, Timestamp, TxnId, TxnKind
@@ -83,7 +84,8 @@ class Node:
                  store_factory: Callable = None,
                  now_us: Callable[[], int] = None,
                  events: EventsListener = None,
-                 trace=None):
+                 trace=None, obs=None):
+        from accord_tpu.obs import CounterDict, NodeObs
         from accord_tpu.utils.tracing import NO_TRACE
         self.id = node_id
         self.sink = sink
@@ -93,6 +95,11 @@ class Node:
         self.random = random
         self.trace = trace if trace is not None else NO_TRACE
         self.config = config or LocalConfig.default()
+        # observability: one metrics registry + span store per node
+        # (obs/ — instrumented by coordinators, stores, pipeline, hosts).
+        # The clock indirection lets _now_us be assigned below.
+        self.obs = obs if obs is not None else NodeObs(
+            node_id, clock_us=lambda: self._now_us())
         self.topology = TopologyManager(node_id)
         self.command_stores = CommandStores(self, num_shards,
                                             store_factory=store_factory)
@@ -119,9 +126,12 @@ class Node:
         # replies carried invalid-if-undecided; quorum_evidence = merges
         # where a MAJORITY of contacted replicas carried it (the cases the
         # reference invalidates with ZERO extra rounds); inferred_rounds =
-        # ballot-protected Invalidate rounds we launched on that evidence
-        self.infer_stats = {"evidence": 0, "quorum_evidence": 0,
-                            "inferred_rounds": 0}
+        # ballot-protected Invalidate rounds we launched on that evidence.
+        # Registry-backed with the old dict shape preserved (the r5 Infer
+        # A/B harness reads these keys).
+        self.infer_stats = CounterDict(
+            self.obs.registry, "accord_infer_total",
+            ("evidence", "quorum_evidence", "inferred_rounds"))
         self._reply_seq = 0
         # epochs with a live shared refetch timer chain (_ensure_epoch_fetch)
         self._epoch_refetch: set = set()
@@ -274,6 +284,8 @@ class Node:
         result = AsyncResult()
         if self.trace.enabled:
             self.trace.event("coordinate", txn_id=txn_id, kind=txn.kind.name)
+        self.obs.txn_begin(txn_id, kind=txn.kind.name)
+        result.add_callback(lambda v, f: self.obs.txn_end(txn_id, f))
         if txn.kind == TxnKind.EPHEMERAL_READ:
             # invisible single-round read: no recovery registration
             self.with_epoch(txn_id.epoch,
@@ -307,6 +319,9 @@ class Node:
             else self.recovery_attempts.pop(txn_id, None))
         if self.trace.enabled:
             self.trace.event("recover", txn_id=txn_id)
+        self.obs.txn_begin(txn_id, path="recovery")
+        result.add_callback(
+            lambda v, f: self.obs.txn_end(txn_id, f, path="recovery"))
         self.with_epoch(txn_id.epoch,
                         lambda: Recover(self, txn_id, route, result).start())
         return result
@@ -325,6 +340,9 @@ class Node:
         self._arm_coordination_watchdog(txn_id, result, "invalidation")
         if self.trace.enabled:
             self.trace.event("invalidate", txn_id=txn_id)
+        self.obs.txn_begin(txn_id, path="invalidation")
+        result.add_callback(
+            lambda v, f: self.obs.txn_end(txn_id, f, path="invalidation"))
         self.with_epoch(txn_id.epoch,
                         lambda: Invalidate(self, txn_id, some_route,
                                            result).start())
@@ -424,6 +442,14 @@ class Node:
         if isinstance(to_nodes, int):
             to_nodes = [to_nodes]
         watched = getattr(request, "txn_id", None)
+        if watched is not None and getattr(request, "trace_id", None) is None:
+            # stamp the trace id once: the structural wire codec round-trips
+            # instance attributes, so every replica can stitch this request
+            # into the transaction's span (obs/spans.py)
+            try:
+                request.trace_id = _trace_key(watched)
+            except AttributeError:
+                pass  # slotted request without __dict__: not traceable
         if watched is not None and watched not in self._coordination_activity:
             watched = None
         for to in to_nodes:
@@ -468,6 +494,12 @@ class Node:
         self._process(request, from_id, reply_context)
 
     def _process(self, request: Request, from_id: int, reply_context) -> None:
+        tid = getattr(request, "trace_id", None)
+        if tid is not None:
+            # stitch this replica into the transaction's cross-node span
+            mt = request.type
+            self.obs.rx(tid, mt.label if mt is not None
+                        else type(request).__name__, from_id)
         if self.journal is not None and request.type is not None \
                 and request.type.has_side_effects:
             self.journal.record(self.id, request)

@@ -81,6 +81,12 @@ class MessageType(enum.Enum):
 
 class Message:
     type: MessageType = None  # set by subclasses
+    # optional per-transaction trace id (obs/spans.py), stamped by
+    # Node.send on requests that carry a txn_id.  Set as an INSTANCE
+    # attribute so host/wire.py's structural codec round-trips it inside
+    # the existing wire envelope; the class default keeps untraced
+    # messages allocation-free.
+    trace_id: Optional[str] = None
 
 
 class Reply(Message):

@@ -1,0 +1,40 @@
+"""Unified observability: metrics registry + per-transaction trace spans.
+
+The reference observes through slf4j logging plus ad-hoc burn counters;
+this package replaces the port's scattered stat dicts (`Node.infer_stats`,
+the pipeline's per-stage counters, the device store's flush-window tallies)
+with one process-local layer:
+
+  * `registry` — counters, gauges and log-bucketed histograms with labels,
+    snapshot()-able to plain JSON and renderable as Prometheus text;
+  * `spans` — lightweight per-transaction trace spans keyed by the txn id,
+    following a transaction through PreAccept -> Accept -> Commit ->
+    Execute/Apply and tagging fast-path / slow-path / recovery.  The trace
+    id rides INSIDE the existing wire envelopes (`messages/base.py` sets an
+    optional `trace_id` attribute that `host/wire.py`'s structural codec
+    round-trips for free), so a span stitches across replicas in sim and
+    over TCP alike;
+  * `node_obs.NodeObs` — the per-Node facade the engine instruments
+    against (one registry + one span store per node);
+  * `httpd` — the Prometheus-style text endpoint (`ACCORD_METRICS_PORT`);
+  * `report` — cross-node snapshot merging and the human summary the
+    bench and burn harnesses record.
+
+HARD CONSTRAINT: nothing in this package may import jax (directly or
+transitively) — the registry lives on the host path only, never inside
+jitted code.  tests/test_obs_budget.py enforces this plus a <5% overhead
+bound on the scalar hot loop.
+"""
+
+from accord_tpu.obs.node_obs import NodeObs
+from accord_tpu.obs.registry import (Counter, Gauge, Histogram, Registry,
+                                     parse_labels)
+from accord_tpu.obs.spans import (SpanStore, find_trace_ids, stitch,
+                                  trace_key)
+from accord_tpu.obs.views import CounterDict, MetricView, bind_metric_views
+
+__all__ = [
+    "Counter", "CounterDict", "Gauge", "Histogram", "MetricView", "NodeObs",
+    "Registry", "SpanStore", "bind_metric_views", "find_trace_ids",
+    "parse_labels", "stitch", "trace_key",
+]

@@ -1,0 +1,127 @@
+"""NodeObs: the per-node observability facade the engine instruments on.
+
+One registry + one span store per Node.  Coordinators call `txn_begin` /
+`txn_phase` / `txn_path` / `txn_end` at protocol milestones; `Node._process`
+calls `rx` for any inbound request carrying a trace id.  Everything is a
+few dict ops — the <5% hot-loop budget is enforced by
+tests/test_obs_budget.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from accord_tpu.obs.registry import Registry
+from accord_tpu.obs.spans import SpanStore, trace_key
+
+# protocol milestones in coordination order; the per-phase latency
+# breakdown is the delta between consecutive *present* milestones
+PHASE_ORDER = ("begin", "preaccept", "preaccept_extend", "begin_recover",
+               "accept", "commit", "stable", "apply", "end")
+
+# milestones that each open one RPC round (fan-out + quorum wait): their
+# per-txn count is the round-count histogram the ROADMAP Infer A/B
+# harness prices against
+ROUND_PHASES = frozenset({"preaccept", "preaccept_extend", "accept",
+                          "commit", "stable", "apply", "begin_recover",
+                          "get_deps", "await_commit", "invalidate"})
+
+
+class NodeObs:
+    """Per-node metrics registry + span store + instrumentation helpers."""
+
+    __slots__ = ("node_id", "registry", "spans", "enabled", "_clock_us")
+
+    def __init__(self, node_id: int = 0, registry: Optional[Registry] = None,
+                 clock_us: Optional[Callable[[], int]] = None,
+                 span_capacity: int = 4096, enabled: bool = True):
+        self.node_id = node_id
+        self.registry = registry if registry is not None else Registry()
+        self.spans = SpanStore(node_id, capacity=span_capacity)
+        self.enabled = enabled
+        self._clock_us = clock_us if clock_us is not None else (lambda: 0)
+
+    def now_us(self) -> int:
+        return int(self._clock_us())
+
+    # -------------------------------------------------- coordination side --
+    def txn_begin(self, txn_id, kind: Optional[str] = None,
+                  path: str = "coordination") -> None:
+        if not self.enabled:
+            return
+        self.registry.counter("accord_coordinate_started_total",
+                              path=path).inc()
+        span = self.spans.event(trace_key(txn_id), "begin", self.now_us(),
+                                {"path": path, "kind": kind} if kind
+                                else {"path": path})
+        span.path = path
+
+    def txn_phase(self, txn_id, phase: str, **tags) -> None:
+        if not self.enabled:
+            return
+        self.spans.event(trace_key(txn_id), phase, self.now_us(),
+                         tags or None)
+
+    def txn_path(self, txn_id, which: str) -> None:
+        """Record the decided commit path ("fast" | "slow").  Idempotent
+        per trace: a coordination that re-decides after an epoch-extension
+        round must not double-count its path."""
+        if not self.enabled:
+            return
+        tid = trace_key(txn_id)
+        span = self.spans.get(tid)
+        if span is not None and span.first("path") is not None:
+            return
+        self.registry.counter("accord_path_total", path=which).inc()
+        span = self.spans.event(tid, "path", self.now_us(), {"path": which})
+        span.path = which
+
+    def txn_end(self, txn_id, failure: Optional[BaseException] = None,
+                path: str = "coordination") -> None:
+        if not self.enabled:
+            return
+        outcome = "ok" if failure is None else type(failure).__name__
+        self.registry.counter("accord_coordinate_outcomes_total",
+                              outcome=outcome, path=path).inc()
+        now = self.now_us()
+        span = self.spans.event(trace_key(txn_id), "end", now,
+                                {"outcome": outcome})
+        begin = span.first("begin")
+        if begin is not None:
+            self.registry.histogram("accord_txn_latency_us",
+                                    path=span.path or path) \
+                .observe(max(0, now - begin[0]))
+        rounds = sum(1 for _, ph, _ in span.events if ph in ROUND_PHASES)
+        if rounds:
+            self.registry.histogram("accord_coordination_rounds",
+                                    path=span.path or path).observe(rounds)
+        self._observe_phase_latencies(span)
+
+    def _observe_phase_latencies(self, span) -> None:
+        """Delta between consecutive present milestones -> per-phase
+        latency histograms (first occurrence of each milestone)."""
+        firsts = []
+        for ph in PHASE_ORDER:
+            ev = span.first(ph)
+            if ev is not None:
+                firsts.append((ph, ev[0]))
+        for (ph, at), (_nph, nat) in zip(firsts, firsts[1:]):
+            self.registry.histogram("accord_phase_latency_us", phase=ph) \
+                .observe(max(0, nat - at))
+
+    # -------------------------------------------------------- replica side --
+    def rx(self, trace_id: str, verb: str, from_id: int) -> None:
+        """Inbound traced request: stitch this replica into the span."""
+        if not self.enabled:
+            return
+        self.spans.event(trace_id, f"rx:{verb}", self.now_us(),
+                         {"from": from_id})
+
+    # ------------------------------------------------------------ export --
+    def snapshot(self) -> dict:
+        """JSON-safe per-node snapshot (the wire/bench/burn interchange
+        format; merge with obs.report.merge_node_snapshots)."""
+        from accord_tpu.obs.report import summarize
+        metrics = self.registry.snapshot()
+        return {"node": self.node_id, "metrics": metrics,
+                "summary": summarize(metrics)}

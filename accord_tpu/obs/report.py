@@ -1,0 +1,112 @@
+"""Cross-node snapshot merging + the human/bench summary.
+
+`summarize` turns a (possibly merged) registry snapshot into the compact
+report the bench records next to its BENCH_HISTORY row and the burn prints
+at end of run: fast-path ratio, coordination outcomes, per-phase latency
+quantiles, device flush-window counts, pipeline admission counters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from accord_tpu.obs.registry import (merge_snapshots, parse_labels,
+                                     snapshot_quantile)
+
+
+def merge_node_snapshots(snaps: List[dict]) -> dict:
+    """Merge NodeObs.snapshot() dicts from several nodes/processes into one
+    cluster view: {"nodes": [...], "metrics": merged, "summary": ...}."""
+    snaps = [s for s in snaps if s]
+    metrics = merge_snapshots([s.get("metrics", {}) for s in snaps])
+    return {"nodes": [s.get("node") for s in snaps], "metrics": metrics,
+            "summary": summarize(metrics)}
+
+
+def _counter_by_label(metrics: dict, name: str, label: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for lk, v in metrics.get("counters", {}).get(name, {}).items():
+        key = parse_labels(lk).get(label, "")
+        out[key] = out.get(key, 0) + v
+    return out
+
+
+def _counter_total(metrics: dict, name: str) -> int:
+    return sum(metrics.get("counters", {}).get(name, {}).values())
+
+
+def _gauge_max(metrics: dict, name: str) -> int:
+    vals = metrics.get("gauges", {}).get(name, {}).values()
+    return max(vals) if vals else 0
+
+
+def _hists_by_label(metrics: dict, name: str, label: str) -> Dict[str, dict]:
+    """Merge one histogram family's snapshots grouped by a label value."""
+    out: Dict[str, dict] = {}
+    for lk, h in metrics.get("histograms", {}).get(name, {}).items():
+        key = parse_labels(lk).get(label, "")
+        cur = out.setdefault(key, {"count": 0, "sum": 0, "buckets": {}})
+        cur["count"] += h.get("count", 0)
+        cur["sum"] += h.get("sum", 0)
+        for e, n in h.get("buckets", {}).items():
+            cur["buckets"][e] = cur["buckets"].get(e, 0) + n
+    return out
+
+
+def _hist_report(h: dict) -> dict:
+    count = h.get("count", 0)
+    return {"count": count,
+            "mean": round(h.get("sum", 0) / count, 1) if count else 0.0,
+            "p50": snapshot_quantile(h, 0.50),
+            "p95": snapshot_quantile(h, 0.95)}
+
+
+def summarize(metrics: dict) -> dict:
+    paths = _counter_by_label(metrics, "accord_path_total", "path")
+    fast = paths.get("fast", 0)
+    slow = paths.get("slow", 0)
+    outcomes = _counter_by_label(metrics,
+                                 "accord_coordinate_outcomes_total",
+                                 "outcome")
+    started = _counter_by_label(metrics, "accord_coordinate_started_total",
+                                "path")
+    phase_hists = _hists_by_label(metrics, "accord_phase_latency_us",
+                                  "phase")
+    return {
+        "fast_path": fast,
+        "slow_path": slow,
+        "fast_path_ratio": (round(fast / (fast + slow), 4)
+                            if fast + slow else None),
+        "started": started,
+        "outcomes": outcomes,
+        "recoveries": started.get("recovery", 0),
+        "phase_latency_us": {ph: _hist_report(h)
+                             for ph, h in sorted(phase_hists.items())},
+        "txn_latency_us": {p: _hist_report(h) for p, h in sorted(
+            _hists_by_label(metrics, "accord_txn_latency_us",
+                            "path").items())},
+        "device": {
+            "flush_windows": _counter_total(
+                metrics, "accord_device_flush_windows_total"),
+            "cross_txn_windows": _counter_total(
+                metrics, "accord_device_cross_txn_windows_total"),
+            "window_txn_max": _gauge_max(metrics,
+                                         "accord_device_window_txn_max"),
+            "hits": _counter_total(metrics, "accord_device_hits_total"),
+            "misses": _counter_total(metrics, "accord_device_misses_total"),
+            "compile_shapes": _counter_total(
+                metrics, "accord_device_compile_shapes_total"),
+        },
+        "pipeline": {
+            "submitted": _counter_total(metrics,
+                                        "accord_pipeline_submitted_total"),
+            "shed": _counter_total(metrics, "accord_pipeline_shed_total"),
+            "batches": _counter_total(metrics,
+                                      "accord_pipeline_batches_total"),
+            "dispatched": _counter_total(metrics,
+                                         "accord_pipeline_dispatched_total"),
+            "batch_size_max": _gauge_max(metrics,
+                                         "accord_pipeline_batch_size_max"),
+        },
+        "infer": _counter_by_label(metrics, "accord_infer_total", "kind"),
+    }

@@ -41,7 +41,9 @@ class Pipeline:
                  config: Optional[PipelineConfig] = None):
         self.node = node
         self.config = config if config is not None else PipelineConfig()
-        self.stats = PipelineStats()
+        # per-stage counters live in the node's metrics registry (obs/)
+        registry = getattr(getattr(node, "obs", None), "registry", None)
+        self.stats = PipelineStats(registry=registry)
         self.batcher = BatchCoordinator(node, self.stats)
         self.ingest = IngestQueue(
             scheduler if scheduler is not None else node.scheduler,

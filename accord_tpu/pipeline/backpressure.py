@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from accord_tpu.coordinate.errors import CoordinationFailed
+from accord_tpu.obs.views import MetricView, bind_metric_views
 
 
 class Rejected(CoordinationFailed):
@@ -38,20 +39,37 @@ class AdmissionController:
 class PipelineStats:
     """Per-stage counters for the ingest pipeline.  Mutated only from the
     owning node's loop thread (the pipeline is single-threaded by
-    construction, like the command stores)."""
+    construction, like the command stores).
 
-    def __init__(self):
-        self.submitted = 0       # client txns offered to the pipeline
-        self.admitted = 0        # accepted into the admission queue
-        self.shed = 0            # rejected with a typed Rejected reply
-        self.batches = 0         # micro-batches dispatched
-        self.dispatched = 0      # txns handed to the batch coordinator
-        self.completed = 0       # txns settled successfully
-        self.failed = 0          # txns settled with a (non-shed) failure
-        self.deadline_closes = 0  # batches closed by max_wait expiry
-        self.size_closes = 0      # batches closed by reaching max_batch
-        self.depth_max = 0       # admission-queue high-water mark
-        self.batch_size_max = 0
+    Registry-backed (obs/): the attribute names are read-through views over
+    the node's metrics registry, so existing harness reads (`stats.shed`,
+    `stats.batches`) and the snapshot() dict keep working while the same
+    numbers flow to the Prometheus endpoint and bench/burn snapshots."""
+
+    submitted = MetricView("accord_pipeline_submitted_total")
+    admitted = MetricView("accord_pipeline_admitted_total")
+    shed = MetricView("accord_pipeline_shed_total")
+    batches = MetricView("accord_pipeline_batches_total")
+    dispatched = MetricView("accord_pipeline_dispatched_total")
+    completed = MetricView("accord_pipeline_completed_total")
+    failed = MetricView("accord_pipeline_failed_total")
+    deadline_closes = MetricView("accord_pipeline_deadline_closes_total")
+    size_closes = MetricView("accord_pipeline_size_closes_total")
+    depth_max = MetricView("accord_pipeline_depth_max", kind="gauge")
+    batch_size_max = MetricView("accord_pipeline_batch_size_max",
+                                kind="gauge")
+
+    def __init__(self, registry=None, **labels):
+        if registry is None:  # standalone (tests, bare queues)
+            from accord_tpu.obs.registry import Registry
+            registry = Registry()
+        bind_metric_views(self, registry, **labels)
+        self._g_depth = registry.gauge("accord_pipeline_queue_depth",
+                                      **labels)
+        self._h_batch_size = registry.histogram(
+            "accord_pipeline_batch_size", **labels)
+        self._h_queue_wait = registry.histogram(
+            "accord_pipeline_queue_wait_us", **labels)
         self._queue_wait_us_sum = 0   # admission -> dispatch
         self._service_us_sum = 0      # dispatch -> settle
         self._latency_n = 0
@@ -61,6 +79,7 @@ class PipelineStats:
         self.submitted += 1
         self.admitted += 1
         self.depth_max = max(self.depth_max, depth)
+        self._g_depth.value = depth
 
     def record_shed(self) -> None:
         self.submitted += 1
@@ -71,6 +90,8 @@ class PipelineStats:
         self.batches += 1
         self.dispatched += size
         self.batch_size_max = max(self.batch_size_max, size)
+        self._h_batch_size.observe(size)
+        self._h_queue_wait.observe(queue_wait_us_total // max(1, size))
         if by_deadline:
             self.deadline_closes += 1
         else:

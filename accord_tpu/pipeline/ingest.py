@@ -177,6 +177,8 @@ class IngestQueue:
                                  waited_us=waited)
             self.dispatch(batch)
             by_deadline = False  # only the first pop is deadline-credited
+        # the admission-queue depth gauge tracks drains as well as admits
+        self.stats._g_depth.value = len(self._q)
         if self._q:
             self._arm()
 

@@ -31,6 +31,11 @@ class BurnStats:
     def __init__(self):
         self.acks = 0
         self.nacks = 0
+        # pipeline admission sheds (typed Rejected: never coordinated, safe
+        # to retry) — surfaced in the summary as their own tally instead of
+        # being folded into nacks, so a shedding run is distinguishable
+        # from a failing one
+        self.shed = 0
         self.lost = 0
         self.pending = 0
         # submit->ack VIRTUAL latency per acked op (us): the measurement for
@@ -48,8 +53,8 @@ class BurnStats:
         return s[min(len(s) - 1, max(0, rank - 1))]
 
     def __repr__(self):
-        return (f"acks={self.acks} nacks={self.nacks} lost={self.lost} "
-                f"pending={self.pending}")
+        return (f"acks={self.acks} nacks={self.nacks} shed={self.shed} "
+                f"lost={self.lost} pending={self.pending}")
 
 
 class BurnRun:
@@ -176,9 +181,15 @@ class BurnRun:
             result = cluster.pipeline_submit(origin, txn)
 
             def done(value, failure):
+                from accord_tpu.pipeline.backpressure import Rejected
                 inflight[0] -= 1
                 end_us = cluster.queue.clock.now_us
-                if failure is not None:
+                if isinstance(failure, Rejected):
+                    # admission shed: its own summary tally (the txn was
+                    # never coordinated — folding it into nacks hid every
+                    # pipeline shed inside the failure count)
+                    self.stats.shed += 1
+                elif failure is not None:
                     self.stats.nacks += 1
                 elif isinstance(value, ListResult):
                     self.stats.acks += 1
@@ -231,8 +242,8 @@ class BurnRun:
             if not self._has_unapplied_decided():
                 break
         self.stats.pending = inflight[0]
-        tally = (self.stats.acks + self.stats.nacks + self.stats.lost
-                 + self.stats.pending)
+        tally = (self.stats.acks + self.stats.nacks + self.stats.shed
+                 + self.stats.lost + self.stats.pending)
         assert tally == submitted[0], \
             f"op accounting leak: {self.stats} vs submitted={submitted[0]}"
 
@@ -249,6 +260,21 @@ class BurnRun:
             self.journal_checked, self.journal_skipped = \
                 validate_cluster(self.cluster)
         return self.stats
+
+    # ---------------------------------------------------- observability --
+    def metrics_snapshot(self) -> dict:
+        """End-of-run cluster obs report (assertable in hostile tests):
+        merged registries + summary (fast-path ratio, outcomes, per-phase
+        latency, device flush windows, pipeline counters)."""
+        return self.cluster.metrics_snapshot()
+
+    def stitched_trace(self, trace_id: str):
+        return self.cluster.stitched_trace(trace_id)
+
+    def recovered_trace_ids(self):
+        """Trace ids for which some node began a recovery coordination."""
+        return self.cluster.find_trace_ids(phase="begin",
+                                           path="recovery")
 
     def _has_unapplied_decided(self) -> bool:
         """Any stable-or-outcome-holding command still waiting to execute?"""
@@ -330,6 +356,9 @@ def main(argv=None) -> int:
     parser.add_argument("--trace", action="store_true",
                         help="record structured protocol events per node and "
                              "print the tail after the run")
+    parser.add_argument("--metrics", action="store_true",
+                        help="print the end-of-run obs report (merged "
+                             "metrics registry summary, JSON)")
     args = parser.parse_args(argv)
     if args.device_store or args.mesh_store:
         # the device store initialises jax: probe the (possibly
@@ -441,6 +470,9 @@ def main(argv=None) -> int:
               f"lat_p50={lat(50)} lat_p95={lat(95)} "
               f"virtual_time={run.cluster.now_s:.1f}s "
               f"events={run.cluster.queue.processed} OK{extra}")
+        if args.metrics:
+            import json as _json
+            print("obs " + _json.dumps(run.metrics_snapshot()["summary"]))
         if args.message_stats:
             # per-verb delivery/drop counters (reference burn reports
             # messageStatsMap per message type, BurnTest.java:510+)

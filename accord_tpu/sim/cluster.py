@@ -104,6 +104,7 @@ class SimCluster:
             now_us = (DriftingClock(self.queue.clock, self.random.fork()).now_us
                       if clock_drift
                       else (lambda: self.queue.clock.now_us))
+            from accord_tpu.obs import NodeObs
             from accord_tpu.utils.tracing import Trace
             node = Node(
                 nid, sink, agent, self.scheduler, ListStore(nid),
@@ -114,6 +115,13 @@ class SimCluster:
                 trace=Trace(nid, enabled=True,
                             clock=lambda: self.queue.clock.now_us / 1e6)
                 if trace else None,
+                # span timestamps come from the UNDRIFTED virtual clock:
+                # DriftingClock.now_us steps a random walk per call, so
+                # clocking obs events through it would perturb the very
+                # protocol behavior being observed (and mis-order stitched
+                # cross-node traces)
+                obs=NodeObs(nid,
+                            clock_us=lambda: self.queue.clock.now_us),
             )
             node.journal = self.journal
             self.agents[nid] = agent
@@ -193,3 +201,22 @@ class SimCluster:
 
     def node(self, node_id: int) -> Node:
         return self.nodes[node_id]
+
+    # -------------------------------------------------------- observability --
+    def metrics_snapshot(self) -> dict:
+        """Cluster-wide obs snapshot: per-node registries merged (counters/
+        histograms sum, gauges max) plus the computed summary."""
+        from accord_tpu.obs.report import merge_node_snapshots
+        return merge_node_snapshots(
+            [n.obs.snapshot() for n in self.nodes.values()])
+
+    def stitched_trace(self, trace_id: str):
+        """One transaction's span events merged across every replica that
+        recorded it: [(at_us, node_id, phase, tags)]."""
+        from accord_tpu.obs.spans import stitch
+        return stitch([n.obs.spans for n in self.nodes.values()], trace_id)
+
+    def find_trace_ids(self, phase: str = None, **tags):
+        from accord_tpu.obs.spans import find_trace_ids
+        return find_trace_ids([n.obs.spans for n in self.nodes.values()],
+                              phase=phase, **tags)

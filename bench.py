@@ -83,9 +83,14 @@ def _regression_guard(result: dict) -> None:
                 pct = -pct
             if pct < -10.0:
                 result["REGRESSION_vs_prev_pct"] = round(pct, 1)
-        history.setdefault(CONFIG, {})[pclass] = {
+        entry = {
             "value": value, "platform": result.get("platform", "host"),
             "unix": int(time.time())}
+        if "obs" in result:
+            # metrics snapshot rides with the BENCH row (fast-path ratio,
+            # per-phase latency histograms, device flush-window counts)
+            entry["obs"] = result["obs"]
+        history.setdefault(CONFIG, {})[pclass] = entry
         # pid-unique tmp: the --fill loop and interactive runs may emit
         # concurrently; a shared tmp path could interleave truncated JSON
         tmp = f"{HISTORY_PATH}.tmp{os.getpid()}"
@@ -795,6 +800,15 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16,
         for o in obs:
             verifier.observe(o)
         verifier.verify(final)  # raises on any anomaly
+
+        # obs snapshot from every node process (JSON over the frame
+        # transport; the Prometheus endpoint is the ACCORD_METRICS_PORT
+        # alternative) — recorded in the BENCH row: fast-path ratio,
+        # per-phase latency histograms, device flush-window counts
+        from accord_tpu.obs.report import merge_node_snapshots
+        snaps = [c.fetch_metrics(i) for i in range(1, nodes + 1)]
+        merged = merge_node_snapshots([s for s in snaps if s])
+        obs_summary = merged["summary"] if merged["nodes"] else None
     finally:
         c.close()
     assert acked > 0.9 * n_ops, (acked, completed)
@@ -811,6 +825,8 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16,
         "wall_seconds": round(dt, 2),
         "verified": "strict-serializable",
     }
+    if obs_summary is not None:
+        result["obs"] = obs_summary
     if extra_fields:
         result.update(extra_fields)
     emit(result)

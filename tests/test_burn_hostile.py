@@ -22,6 +22,31 @@ def test_burn_hostile(seed):
     assert run.partition_nemesis.partitions_applied > 0
 
 
+def test_burn_hostile_stitched_recovery_trace():
+    """Observability acceptance (obs/): under loss + partitions + drift, at
+    least one recovered transaction must yield a CROSS-REPLICA stitched
+    trace — the recovering coordinator's `begin(path=recovery)` span plus
+    `rx:BEGIN_RECOVER_REQ` events recorded by the replicas it contacted,
+    all under the same trace id — and the merged metrics registry must
+    agree with the span-level evidence."""
+    run = BurnRun(23, 80, drop_prob=0.1, partitions=True, clock_drift=True)
+    stats = run.run()
+    assert stats.acks > 0
+    recovered = run.recovered_trace_ids()
+    assert recovered, "hostile run produced no recoveries to trace"
+    stitched = 0
+    for tid in recovered:
+        events = run.stitched_trace(tid)
+        nodes = {n for _, n, _, _ in events}
+        phases = [ph for _, _, ph, _ in events]
+        if len(nodes) >= 2 and "rx:BEGIN_RECOVER_REQ" in phases:
+            stitched += 1
+    assert stitched > 0, "no recovery stitched across >=2 replicas"
+    summary = run.metrics_snapshot()["summary"]
+    assert summary["recoveries"] >= len(recovered)
+    assert summary["outcomes"], "registry lost the coordination outcomes"
+
+
 def test_burn_hostile_heavy_loss():
     run = BurnRun(41, 60, drop_prob=0.2, partitions=True, clock_drift=True)
     stats = run.run()

@@ -1,0 +1,257 @@
+"""Observability layer (accord_tpu/obs/): registry semantics, trace-id
+propagation through the wire codec and across a live SimCluster, registry
+consistency under concurrent scheduling, read-through stat views, the
+Prometheus/JSON endpoint, and burn shed surfacing."""
+
+import json
+import urllib.request
+
+import pytest
+
+from accord_tpu.obs import (CounterDict, NodeObs, Registry, stitch,
+                            trace_key)
+from accord_tpu.obs.registry import merge_snapshots, snapshot_quantile
+from accord_tpu.obs.report import merge_node_snapshots, summarize
+from accord_tpu.obs.spans import SpanStore, find_trace_ids
+from accord_tpu.sim.cluster import SimCluster
+from tests.test_topology_change import run_txn, rw_txn
+
+
+# ------------------------------------------------------------- registry ----
+
+def test_counter_gauge_histogram_basics():
+    reg = Registry()
+    c = reg.counter("accord_test_total", kind="a")
+    c.inc()
+    c.inc(3)
+    assert reg.counter("accord_test_total", kind="a") is c  # get-or-create
+    assert reg.value("accord_test_total", kind="a") == 4
+    assert reg.value("accord_test_total", kind="b") == 0
+    reg.counter("accord_test_total", kind="b").inc(2)
+    assert reg.total("accord_test_total") == 6
+
+    g = reg.gauge("accord_test_depth")
+    g.set(7)
+    assert reg.value("accord_test_depth") == 7
+
+    h = reg.histogram("accord_test_latency_us")
+    for v in (1, 1, 3, 100, 5000):
+        h.observe(v)
+    assert h.count == 5 and h.sum == 5105
+    assert h.quantile(0.5) == 4          # bucket upper bound of v=3
+    assert h.quantile(1.0) == 8192       # bucket holding 5000
+    assert h.mean == pytest.approx(1021.0)
+
+
+def test_snapshot_merge_and_quantile():
+    a, b = Registry(), Registry()
+    a.counter("n_total", path="fast").inc(3)
+    b.counter("n_total", path="fast").inc(2)
+    b.counter("n_total", path="slow").inc(1)
+    a.gauge("depth").set(5)
+    b.gauge("depth").set(9)
+    for v in (10, 20):
+        a.histogram("lat_us").observe(v)
+    b.histogram("lat_us").observe(3000)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    assert merged["counters"]["n_total"]["path=fast"] == 5
+    assert merged["counters"]["n_total"]["path=slow"] == 1
+    assert merged["gauges"]["depth"][""] == 9  # gauges merge by max
+    h = merged["histograms"]["lat_us"][""]
+    assert h["count"] == 3 and h["sum"] == 3030
+    assert snapshot_quantile(h, 1.0) == 4096
+
+
+def test_prometheus_render():
+    reg = Registry()
+    reg.counter("accord_x_total", path="fast").inc(2)
+    reg.gauge("accord_depth").set(4)
+    reg.histogram("accord_lat_us").observe(100)
+    text = reg.render_prometheus()
+    assert '# TYPE accord_x_total counter' in text
+    assert 'accord_x_total{path="fast"} 2' in text
+    assert "accord_depth 4" in text
+    assert 'accord_lat_us_bucket{le="128"} 1' in text
+    assert "accord_lat_us_count 1" in text
+
+
+def test_counter_dict_view_keeps_dict_shape():
+    reg = Registry()
+    d = CounterDict(reg, "accord_infer_total",
+                    ("evidence", "quorum_evidence", "inferred_rounds"))
+    d["evidence"] += 2
+    d["inferred_rounds"] = 5
+    assert d["evidence"] == 2 and d["quorum_evidence"] == 0
+    assert d == {"evidence": 2, "quorum_evidence": 0, "inferred_rounds": 5}
+    assert set(d) == {"evidence", "quorum_evidence", "inferred_rounds"}
+    # the registry IS the storage
+    assert reg.value("accord_infer_total", kind="evidence") == 2
+
+
+def test_span_store_is_bounded():
+    store = SpanStore(1, capacity=8)
+    for i in range(30):
+        store.event(f"t{i}", "begin", i)
+    assert len(store) == 8
+    assert store.get("t0") is None and store.get("t29") is not None
+
+
+# ------------------------------------------------- trace-id propagation ----
+
+def test_trace_id_round_trips_through_wire_codec():
+    from accord_tpu.host.wire import decode_message, encode_message
+    from accord_tpu.messages.preaccept import PreAccept
+    from accord_tpu.primitives.keys import Key, Keys, Route
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    from accord_tpu.primitives.txn import Txn
+
+    txn_id = TxnId.create(1, 100, TxnKind.WRITE, Domain.KEY, 2)
+    keys = Keys.of(5)
+    route = Route.of_keys(keys.as_routing()[0], keys.as_routing())
+    msg = PreAccept(txn_id, Txn(TxnKind.WRITE, keys), route, 1,
+                    full_route=route)
+    assert msg.trace_id is None          # untraced by default (class attr)
+    msg.trace_id = trace_key(txn_id)
+    decoded = decode_message(json.loads(json.dumps(encode_message(msg))))
+    assert decoded.trace_id == trace_key(txn_id)
+    assert decoded.txn_id == txn_id
+    # and an untraced message stays untraced through the codec
+    bare = PreAccept(txn_id, Txn(TxnKind.WRITE, keys), route, 1,
+                     full_route=route)
+    assert decode_message(encode_message(bare)).trace_id is None
+
+
+def test_span_stitches_across_all_replicas_in_sim():
+    cluster = SimCluster(n_nodes=3, seed=11)
+    run_txn(cluster, 1, rw_txn([5], {5: 1}))
+    cluster.process_all()
+    # exactly one client coordination: find its trace
+    ids = cluster.find_trace_ids(phase="begin", path="coordination")
+    assert len(ids) == 1
+    (tid,) = ids
+    # rf = n_nodes here: every replica participated and recorded rx events
+    for nid, node in cluster.nodes.items():
+        span = node.obs.spans.get(tid)
+        assert span is not None, f"node {nid} has no span for {tid}"
+        if nid != 1:
+            assert any(ph.startswith("rx:") for ph in span.phases()), nid
+    events = cluster.stitched_trace(tid)
+    nodes_seen = {n for _, n, _, _ in events}
+    phases = [ph for _, _, ph, _ in events]
+    assert nodes_seen == {1, 2, 3}
+    assert "begin" in phases and "end" in phases
+    assert any(ph == "rx:PRE_ACCEPT_REQ" for ph in phases)
+    # the coordinator recorded the protocol milestones in order
+    coord = [ph for _, n, ph, _ in events if n == 1]
+    assert coord.index("begin") < coord.index("preaccept") \
+        < coord.index("stable") < coord.index("apply") < coord.index("end")
+
+
+def test_registry_consistent_under_concurrent_scheduling():
+    """N interleaved coordinations: every started coordination settles
+    (started == outcomes per node), every client txn decided exactly one
+    path, and the merged summary agrees with the per-txn ground truth."""
+    cluster = SimCluster(n_nodes=3, seed=7)
+    results = []
+    n = 24
+    for i in range(n):
+        node_id = 1 + i % 3
+        results.append(cluster.nodes[node_id].coordinate(
+            rw_txn([i % 6], {i % 6: i})))
+    assert cluster.process_until(
+        lambda: all(r.is_done for r in results), max_items=5_000_000)
+    cluster.process_all()
+    assert all(r.failure() is None for r in results)
+    for node in cluster.nodes.values():
+        reg = node.obs.registry
+        assert reg.total("accord_coordinate_started_total") \
+            == reg.total("accord_coordinate_outcomes_total")
+    merged = cluster.metrics_snapshot()
+    summary = merged["summary"]
+    assert summary["fast_path"] + summary["slow_path"] == n
+    assert summary["outcomes"].get("ok", 0) == n
+    assert summary["fast_path_ratio"] is not None
+    assert summary["phase_latency_us"]["preaccept"]["count"] >= n
+
+
+def test_infer_stats_view_on_node():
+    cluster = SimCluster(n_nodes=3, seed=5)
+    node = cluster.node(1)
+    node.infer_stats["evidence"] += 1
+    assert node.infer_stats["evidence"] == 1
+    assert node.obs.registry.value("accord_infer_total",
+                                   kind="evidence") == 1
+    assert dict(node.infer_stats.items())["quorum_evidence"] == 0
+
+
+# ------------------------------------------------------------- endpoint ----
+
+def test_metrics_http_endpoint():
+    from accord_tpu.obs.httpd import start_metrics_server
+    obs = NodeObs(1)
+    obs.registry.counter("accord_path_total", path="fast").inc(3)
+    server = start_metrics_server(lambda: obs, 0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=5).read().decode()
+        assert 'accord_path_total{path="fast"} 3' in text
+        snap = json.loads(urllib.request.urlopen(
+            f"{base}/metrics.json", timeout=5).read().decode())
+        assert snap["node"] == 1
+        assert snap["summary"]["fast_path"] == 3
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        server.shutdown()
+
+
+def test_maybe_start_from_env_port_offset(monkeypatch):
+    from accord_tpu.obs.httpd import maybe_start_from_env
+    monkeypatch.delenv("ACCORD_METRICS_PORT", raising=False)
+    assert maybe_start_from_env(lambda: NodeObs(1)) is None
+    monkeypatch.setenv("ACCORD_METRICS_PORT", "0")
+    server = maybe_start_from_env(lambda: NodeObs(1), node_id=2)
+    try:
+        assert server is not None and server.port > 0
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------------------------ burn integration ----
+
+def test_burn_pipeline_sheds_surface_in_summary():
+    """A pipeline burn with a tiny admission queue must report its Rejected
+    sheds as `shed`, not silently fold them into nacks."""
+    from accord_tpu.pipeline.ingest import PipelineConfig
+    from accord_tpu.sim.burn import BurnRun
+    run = BurnRun(9, 60, concurrency=24, durability=False,
+                  topology_changes=False, pipeline=True,
+                  pipeline_config=PipelineConfig(max_batch=4,
+                                                 max_wait_us=4000,
+                                                 max_queue=2))
+    stats = run.run()
+    pipeline_shed = sum(p.stats.shed
+                       for p in run.cluster.pipelines.values())
+    assert pipeline_shed > 0, "harness did not provoke any shed"
+    assert stats.shed == pipeline_shed
+    assert "shed=" in repr(stats)
+    # and the merged obs snapshot carries the same number
+    assert run.metrics_snapshot()["summary"]["pipeline"]["shed"] \
+        == pipeline_shed
+
+
+def test_burn_metrics_snapshot_and_device_windows():
+    from accord_tpu.impl.device_store import DeviceCommandStore
+    from accord_tpu.sim.burn import BurnRun
+    run = BurnRun(13, 30, durability=False, topology_changes=False,
+                  store_factory=DeviceCommandStore.factory(
+                      flush_window_us=300, verify=True))
+    stats = run.run()
+    assert stats.acks > 0
+    summary = run.metrics_snapshot()["summary"]
+    assert summary["device"]["flush_windows"] > 0
+    assert summary["device"]["hits"] == sum(
+        s.device_hits for node in run.cluster.nodes.values()
+        for s in node.command_stores.all())
+    assert summary["outcomes"].get("ok", 0) >= stats.acks

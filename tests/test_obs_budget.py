@@ -1,0 +1,139 @@
+"""Observability budget guards (tier-1-fast).
+
+Two hard promises from the obs/ package docstring:
+
+  1. no jitted-code dependencies — nothing under accord_tpu/obs/ imports
+     jax (directly, or accord_tpu modules that could pull it in): the
+     registry lives strictly on the host path;
+  2. instrumentation stays under 5% of the scalar local-store hot loop —
+     the per-transaction obs bundle (begin + every phase milestone + path
+     + end, i.e. MORE events than a real fast-path txn records) is priced
+     against the minimal scalar deps work that same transaction induces
+     (one active-conflict scan per replica per key at rf=3 over
+     realistically deep per-key histories).
+"""
+
+import ast
+import os
+import time
+
+import pytest
+
+OBS_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "accord_tpu", "obs")
+
+
+def _imports_of(path):
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            yield node.module
+
+
+def test_obs_package_has_no_jax_dependency():
+    files = [f for f in os.listdir(OBS_DIR) if f.endswith(".py")]
+    assert files, "obs package missing?"
+    allowed_internal = ("accord_tpu.obs",)  # intra-package only
+    for f in files:
+        for mod in _imports_of(os.path.join(OBS_DIR, f)):
+            root = mod.split(".")[0]
+            assert root not in ("jax", "jaxlib", "numpy"), \
+                f"{f} imports {mod}: obs/ must stay off the device path"
+            if root == "accord_tpu":
+                assert mod.startswith(allowed_internal), \
+                    (f"{f} imports {mod}: obs/ may only import within "
+                     f"itself (anything else risks pulling jax in)")
+
+
+def test_obs_import_does_not_require_jax():
+    """Importing the package in a fresh interpreter must not load jax."""
+    import subprocess
+    import sys
+    code = ("import accord_tpu.obs, sys; "
+            "sys.exit(1 if 'jax' in sys.modules else 0)")
+    proc = subprocess.run([sys.executable, "-c", code], timeout=60,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+
+def _build_deep_cfk(n_entries=1024, seed=3):
+    from accord_tpu.local.cfk import CommandsForKey, InternalStatus
+    from accord_tpu.primitives.keys import Key
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    from accord_tpu.utils.random_source import RandomSource
+    rng = RandomSource(seed)
+    cfk = CommandsForKey(Key(1))
+    statuses = [InternalStatus.PREACCEPTED, InternalStatus.ACCEPTED,
+                InternalStatus.COMMITTED, InternalStatus.STABLE,
+                InternalStatus.APPLIED]
+    hlc = 1000
+    for _ in range(n_entries):
+        hlc += 1 + rng.next_int(2)
+        tid = TxnId.create(1, hlc, rng.pick([TxnKind.READ, TxnKind.WRITE]),
+                           Domain.KEY, rng.next_int(8))
+        cfk.update(tid, rng.pick(statuses), None)
+    return cfk, hlc
+
+
+def _obs_txn_bundle_cost_us(reps=400):
+    """min-of-3 per-txn cost of the FULL instrumentation bundle: more
+    span/counter traffic than any real transaction generates (every
+    milestone incl. recovery, a path decision, 3 rx events)."""
+    from accord_tpu.obs import NodeObs
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    obs = NodeObs(1, clock_us=lambda: 0)
+    tids = [TxnId.create(1, 10_000 + i, TxnKind.WRITE, Domain.KEY, 1)
+            for i in range(reps)]
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for tid in tids:
+            obs.txn_begin(tid, kind="WRITE")
+            obs.txn_phase(tid, "preaccept")
+            obs.txn_path(tid, "fast")
+            obs.txn_phase(tid, "accept")
+            obs.txn_phase(tid, "commit")
+            obs.txn_phase(tid, "stable")
+            obs.txn_phase(tid, "apply")
+            key = repr(tid)
+            obs.rx(key, "PRE_ACCEPT_REQ", 2)
+            obs.rx(key, "STABLE_FAST_PATH_REQ", 2)
+            obs.rx(key, "APPLY_MINIMAL_REQ", 3)
+            obs.txn_end(tid, None)
+        dt = (time.perf_counter() - t0) / reps * 1e6
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _scalar_hot_loop_cost_us(reps=200):
+    """min-of-3 cost of the scalar deps work a minimal single-key WRITE
+    induces: one CommandsForKey.map_reduce_active scan per replica (rf=3)
+    over a 1024-entry history — the floor, not the ceiling, of what a real
+    txn's PreAccept round runs."""
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    cfk, hlc = _build_deep_cfk()
+    probe = TxnId.create(1, hlc + 10, TxnKind.WRITE, Domain.KEY, 2)
+    kinds = probe.kind.witnesses()
+    sink = []
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for _replica in range(3):
+                sink.clear()
+                cfk.map_reduce_active(probe, kinds, sink.append)
+        dt = (time.perf_counter() - t0) / reps * 1e6
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def test_obs_overhead_under_5pct_of_scalar_hot_loop():
+    obs_us = _obs_txn_bundle_cost_us()
+    loop_us = _scalar_hot_loop_cost_us()
+    ratio = obs_us / loop_us
+    assert ratio < 0.05, (
+        f"obs bundle {obs_us:.1f}us vs scalar hot loop {loop_us:.1f}us "
+        f"per txn: {ratio:.1%} >= 5% budget")

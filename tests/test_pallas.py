@@ -7,6 +7,7 @@ sweeps); `interpret=True` executes the identical kernel logic, so any
 semantic divergence shows up here.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -18,6 +19,16 @@ from accord_tpu.ops.encode import scalar_deps_oracle
 from accord_tpu.utils.random_source import RandomSource
 
 from tests.test_ops import random_world
+
+# jax < 0.5 interpret mode is missing the state-discharge rules these
+# kernels' run_state/fixpoint formulations need (NotImplementedError at
+# trace time, not a semantic divergence).  xfail(strict=False): on a
+# jax >= 0.5 build — or if a backport lands — they simply run and count.
+_OLD_JAX = tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5)
+xfail_no_state_discharge = pytest.mark.xfail(
+    condition=_OLD_JAX, raises=NotImplementedError, strict=False,
+    reason="pallas interpret mode lacks state-discharge rules on this "
+           "jax build (< 0.5)")
 
 
 @pytest.mark.parametrize("seed", range(4))
@@ -37,6 +48,7 @@ def test_pallas_deps_matches_xla_and_scalar(seed):
 
 
 @pytest.mark.parametrize("seed", range(4))
+@xfail_no_state_discharge
 def test_pallas_wavefront_matches_xla(seed):
     rng = np.random.default_rng(600 + seed)
     n = 128
@@ -47,6 +59,7 @@ def test_pallas_wavefront_matches_xla(seed):
     assert np.array_equal(w_x, w_p)
 
 
+@xfail_no_state_discharge
 def test_pallas_wavefront_deep_chain():
     """The worst case for the fixpoint (B iterations): a full chain plus
     sparse extra edges — the shape where the VMEM-resident kernel wins."""
@@ -73,6 +86,7 @@ def test_pallas_wavefront_large_b_falls_back():
 
 
 @pytest.mark.parametrize("seed", range(2))
+@xfail_no_state_discharge
 def test_pallas_resolve_step_matches_xla(seed):
     rng = RandomSource(700 + seed)
     cfks, batch = random_world(rng, n_keys=10, n_existing=40, n_batch=12)
@@ -88,6 +102,7 @@ def test_pallas_resolve_step_matches_xla(seed):
 
 
 @pytest.mark.parametrize("seed", range(3))
+@xfail_no_state_discharge
 def test_keyset_windows_matches_xla(seed):
     """The fused TPC-C window kernel (shared-key matrix + conflict edges +
     wave fixpoint, all VMEM-resident) must agree per window with
