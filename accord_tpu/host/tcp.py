@@ -405,6 +405,21 @@ class TcpHost:
                                     "req": body.get("req"),
                                     "snapshot": self.node.obs.snapshot()})
             return
+        if kind == "flight":
+            # live forensics view over the frame transport: the node's
+            # flight-recorder tail, or one trace id's events (the same
+            # data the metrics endpoint serves at /flight?txn=)
+            if from_id <= 0:
+                flight = self.node.obs.flight
+                txn = body.get("txn")
+                events = (flight.for_trace(txn) if txn
+                          else flight.tail(int(body.get("limit", 200))))
+                self.emit(from_id, {
+                    "type": "flight_reply", "req": body.get("req"),
+                    "node": self.my_id,
+                    "recorded_total": flight.recorded_total,
+                    "events": [list(e) for e in events]})
+            return
         if kind == "stop":
             # accept stop only from harness/client frames (non-positive
             # declared src).  NOTE: src is self-declared — this guards
@@ -586,6 +601,28 @@ class TcpClusterClient:
             body = frame.get("body", {})
             if body.get("type") == "metrics_reply" and body.get("req") == req:
                 return body.get("snapshot")
+        return None
+
+    def fetch_flight(self, to: int, txn=None, limit: int = 200,
+                     timeout_s: float = 15.0) -> Optional[dict]:
+        """Pull node `to`'s flight-recorder view over the frame transport
+        (same quiet-channel caveat as fetch_metrics)."""
+        req = f"flight-{to}"
+        frame = {"type": "flight", "req": req, "limit": limit}
+        if txn is not None:
+            frame["txn"] = txn
+        try:
+            self._send(to, frame)
+        except OSError:
+            return None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = self.recv(min(1.0, timeout_s))
+            if got is None:
+                continue
+            body = got.get("body", {})
+            if body.get("type") == "flight_reply" and body.get("req") == req:
+                return body
         return None
 
     def close(self) -> None:

@@ -488,6 +488,14 @@ class DeviceCommandStore(CommandStore):
             from accord_tpu.obs.registry import Registry
             registry = Registry()
         bind_metric_views(self, registry, store=store_id)
+        # kernel-level profiler (obs/profiler.py): fenced per-kernel laps +
+        # flush-window waterfall, sampled 1-in-N under ACCORD_PROFILE=N
+        # (off by default; the always-on retrace ledger is a set lookup).
+        # Fencing is the host pull each lap already ends with — the
+        # profiler itself never imports jax.
+        from accord_tpu.obs.profiler import profiler_from_env
+        self.profiler = profiler_from_env(registry)
+        self._window_opened = None  # wall stamp of the window's first submit
         # miss-cause breakdown for the deps arm (hit-rate diagnosis):
         # no_probe (nothing precomputed at this (before, kinds)), version
         # (gate tripped), key_cover (probe didn't cover a queried key)
@@ -523,6 +531,9 @@ class DeviceCommandStore(CommandStore):
             # the dead flush-window deferral entirely
             super()._submit(context, fn, result)
             return
+        if self.profiler.enabled and not self._window:
+            import time as _time
+            self._window_opened = _time.perf_counter()
         self._window.append((context, fn, result))
         if not self._flush_scheduled and self._flush_hold == 0:
             self._flush_scheduled = True
@@ -532,9 +543,11 @@ class DeviceCommandStore(CommandStore):
             else:
                 self.node.scheduler.now(self._flush)
 
-    def _note_compile_shape(self, *shapes) -> None:
+    def _note_compile_shape(self, *shapes, kernel: str = "deps") -> None:
         """First sighting of an encoded-shape bucket == one XLA compile of
-        the kernel at that shape (jit caches per shape tuple)."""
+        the kernel at that shape (jit caches per shape tuple).  The same
+        buckets key the profiler's retrace ledger."""
+        self.profiler.note_retrace(kernel, shapes)
         if shapes not in self._seen_shapes:
             self._seen_shapes.add(shapes)
             self.device_compile_shapes += 1
@@ -572,6 +585,9 @@ class DeviceCommandStore(CommandStore):
             self.device_cross_txn_windows += 1
         self.device_window_txn_max = max(self.device_window_txn_max,
                                          len(window_txns))
+        prof = self.profiler
+        prof.window_begin(self._window_opened)
+        self._window_opened = None
         plan = None
         if not self.device_disabled:
             try:
@@ -596,6 +612,7 @@ class DeviceCommandStore(CommandStore):
                 self._precomputed_ranges = {}
                 self.range_log = None  # no consumer remains; stop logging
                 self.agent.on_handled_exception(exc)
+        prof.window_end()
         if plan is not None:
             window = self._schedule_window(window, plan)
         try:
@@ -666,14 +683,21 @@ class DeviceCommandStore(CommandStore):
         from accord_tpu.ops.deps_kernel import batched_active_deps
         from accord_tpu.ops.encode import BatchEncoder
 
+        # each profiler lap ends at a host pull (np.asarray) — the pull IS
+        # the fence, so "device" measures the kernel, not dispatch overlap
+        t = self.profiler.begin()
         cfks, versions, committed_versions = self._probe_snapshots(probes)
         enc = BatchEncoder.for_probes(cfks, probes)
         s, b = enc.state, enc.dbatch
+        t = self.profiler.lap(t, "deps_encode", stage="encode")
         self._note_compile_shape(s.entry_rank.shape, b.touches.shape)
         dep_mask, _count = batched_active_deps(
             s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
             s.entry_kind, b.txn_rank, b.txn_witness_mask, b.touches)
-        keyed = enc.decode_key_deps(np.asarray(dep_mask))
+        mask_host = np.asarray(dep_mask)
+        t = self.profiler.lap(t, "deps_kernel", stage="device")
+        keyed = enc.decode_key_deps(mask_host)
+        self.profiler.lap(t, "deps_decode", stage="decode")
         self._install_probes(probes, keyed, versions, committed_versions)
 
     def _precompute_recovery(self, window) -> None:
@@ -699,14 +723,20 @@ class DeviceCommandStore(CommandStore):
         from accord_tpu.ops.recovery_kernel import (RecoveryEncoder,
                                                     batched_recovery_scans)
 
+        t = self.profiler.begin()
         touched = sorted({k for _, ks in probes for k in ks})
         cfks = [self.cfks[k] for k in touched if k in self.cfks]
         versions = {k: (self.cfks[k].version if k in self.cfks else 0)
                     for k in touched}
         enc = RecoveryEncoder(cfks, probes)
-        ra, rb, cw, anw = batched_recovery_scans(*enc.args())
+        args = enc.args()
+        t = self.profiler.lap(t, "recovery_encode", stage="encode")
+        self._note_compile_shape(
+            *(getattr(a, "shape", None) for a in args), kernel="recovery")
+        ra, rb, cw, anw = batched_recovery_scans(*args)
         ra, rb = _np.asarray(ra), _np.asarray(rb)
         cw, anw = _np.asarray(cw), _np.asarray(anw)
+        t = self.profiler.lap(t, "recovery_kernel", stage="device")
         self.device_batches += 1
         self.device_batched_probes += len(probes)
         for i, (txn_id, ks) in enumerate(probes):
@@ -714,6 +744,7 @@ class DeviceCommandStore(CommandStore):
                 txn_id, enc.decode_keyed(ra[i]), enc.decode_keyed(rb[i]),
                 enc.decode_keyed(cw[i]), enc.decode_keyed(anw[i]),
                 set(ks), versions)
+        self.profiler.lap(t, "recovery_decode", stage="decode")
 
     def _precompute_ranges(self, window) -> None:
         """Stab the live range-command index with every declared probe's
@@ -780,14 +811,18 @@ class DeviceCommandStore(CommandStore):
                                        dev_starts, dev_ends)
         if not intervals:
             return
+        t = self.profiler.begin()
         all_spans = [sp for _, _, _, _, spans in probes for sp in spans]
         q_pad = _pad_to(len(all_spans), 128)
         qs = np.zeros(q_pad, np.int32)
         qe = np.zeros(q_pad, np.int32)
         for i, (s, e) in enumerate(all_spans):
             qs[i], qe[i] = s, e
+        t = self.profiler.lap(t, "range_encode", stage="encode")
+        self._note_compile_shape(dev_starts.shape, (q_pad,), kernel="range")
         mask = np.asarray(range_stab_mask(
             dev_starts, dev_ends, jnp.asarray(qs), jnp.asarray(qe)))
+        t = self.profiler.lap(t, "range_kernel", stage="device")
         self.device_range_batches += 1
         version = self.range_version
         row = 0
@@ -801,6 +836,7 @@ class DeviceCommandStore(CommandStore):
             self._precomputed_ranges[(before, kinds)] = _RangeProbe(
                 before, kinds, mode, owned_repr, tuple(sorted(cand)),
                 version, log_len=len(self.range_log))
+        self.profiler.lap(t, "range_decode", stage="decode")
 
     # ------------------------------------------------ wavefront scheduling --
     def _plan_waves(self, window):
@@ -854,6 +890,7 @@ class DeviceCommandStore(CommandStore):
         from accord_tpu.ops.encode import _pad_to, witness_mask
         from accord_tpu.ops.wavefront import execution_waves
 
+        t_prof = self.profiler.begin()
         n = len(probes)
         tokens = sorted({t for _, _, toks in probes for t in toks})
         tindex = {t: i for i, t in enumerate(tokens)}
@@ -871,11 +908,15 @@ class DeviceCommandStore(CommandStore):
             txn_kind[i] = int(txn_id.kind)
             for t in toks:
                 touches[i, tindex[t]] = True
+        t_prof = self.profiler.lap(t_prof, "wavefront_encode",
+                                   stage="encode")
+        self._note_compile_shape((b,), (b, kpad), kernel="wavefront")
         dep_bb = in_batch_graph(jnp.asarray(txn_rank),
                                 jnp.asarray(txn_wmask),
                                 jnp.asarray(txn_kind),
                                 jnp.asarray(touches))
         waves = np.asarray(execution_waves(dep_bb))[:n]
+        self.profiler.lap(t_prof, "wavefront_kernel", stage="device")
         if self.verify:
             self._verify_waves(probes, txn_rank, txn_wmask, txn_kind, waves)
         self.device_wave_batches += 1
@@ -988,17 +1029,22 @@ class MeshDeviceCommandStore(DeviceCommandStore):
         from accord_tpu.ops.encode import PAD
         from accord_tpu.ops.sharded import ShardedEncoder
 
+        t = self.profiler.begin()
         cfks, versions, committed_versions = self._probe_snapshots(probes)
         # PAD-granular shape bucketing (not the encoder's default pad=8):
         # each distinct shape recompiles the shared jitted SPMD step
         enc = ShardedEncoder.for_probes(cfks, probes,
                                         n_shards=self._mesh_shards, pad=PAD)
         args = enc.args()
+        t = self.profiler.lap(t, "sharded_encode", stage="encode")
         self._note_compile_shape(*(getattr(a, "shape", None)
-                                   for a in args[:7]))
+                                   for a in args[:7]), kernel="sharded")
         dep_mask, _count = self._sharded_step(
             *args[:5], args[5], args[6], args[8])
-        keyed = enc.decode_key_deps(np.asarray(dep_mask))
+        mask_host = np.asarray(dep_mask)
+        t = self.profiler.lap(t, "sharded_kernel", stage="device")
+        keyed = enc.decode_key_deps(mask_host)
+        self.profiler.lap(t, "sharded_decode", stage="decode")
         self._install_probes(probes, keyed, versions, committed_versions)
 
 

@@ -182,6 +182,15 @@ class SimpleProgressLog(ProgressLog):
         self._informed_home.discard(txn_id)
 
     # -------------------------------------------------------------- polling --
+    def _escalation(self, txn_id: TxnId, what: str, attempts: int) -> None:
+        """Flight-recorder breadcrumb (obs/flight.py): every escalation the
+        liveness engine takes lands on the node's ring, so a post-mortem
+        shows WHY a recovery/fetch round started, not just that it did."""
+        obs = getattr(self.node, "obs", None)
+        if obs is not None:
+            obs.flight.record("escalate", repr(txn_id),
+                              (self.store.id, what, attempts))
+
     def _run(self) -> None:
         now = self._now_s()
         for state in list(self.home.values()):
@@ -208,6 +217,7 @@ class SimpleProgressLog(ProgressLog):
             return
         state.investigating = True
         state.attempts += 1
+        self._escalation(state.txn_id, "investigate_home", state.attempts)
         # first ask the home shard whether anyone progressed; only escalate
         # to a recovery ballot if nobody did (MaybeRecover.java)
         from accord_tpu.coordinate.fetch import maybe_recover
@@ -269,6 +279,7 @@ class SimpleProgressLog(ProgressLog):
             from accord_tpu.local import commands as C
             from accord_tpu.local.store import PreLoadContext
             state.since_s = now
+            self._escalation(state.txn_id, "nudge_execute", state.attempts)
             self.store.execute(PreLoadContext.for_txn(state.txn_id),
                                lambda s: C.maybe_execute(
                                    s, s.get(state.txn_id), False))
@@ -276,6 +287,7 @@ class SimpleProgressLog(ProgressLog):
         # chase the bottom of the waiting chain, not the middle
         root = self._walk_to_root_blocker(state.txn_id)
         if root != state.txn_id and root not in self.blocked:
+            self._escalation(root, "chase_root_blocker", state.attempts)
             root_cmd = self.store.commands.get(root)
             until = ("Applied" if root_cmd is not None
                      and root_cmd.has_been(SaveStatus.COMMITTED)
@@ -299,6 +311,7 @@ class SimpleProgressLog(ProgressLog):
                         and merged.route is not None:
                     state.route = merged.route
                     state.attempts = 0
+            self._escalation(state.txn_id, "find_route", state.attempts)
             find_route(self.node, state.txn_id,
                        state.participants).add_callback(learned)
             return
@@ -306,9 +319,11 @@ class SimpleProgressLog(ProgressLog):
         state.since_s = now
         if state.attempts <= 2:
             # cheap path first: pull the missing commit/apply from its shards
+            self._escalation(state.txn_id, "fetch_data", state.attempts)
             fetch_data(self.node, state.txn_id, route)
         else:
             # still stuck: the txn itself may be undecided — recover it
+            self._escalation(state.txn_id, "recover", state.attempts)
             self._recover(state.txn_id, route, lambda: None)
 
     def _recover(self, txn_id: TxnId, route: Route, on_settled) -> None:

@@ -23,6 +23,27 @@ from accord_tpu.utils import invariants
 from accord_tpu.utils.bitset import SimpleBitSet
 from accord_tpu.utils.sorted_arrays import find_ceil
 
+# flight-recorder hook: local.store rebinds this to CommandStore.current at
+# import time (command.py cannot import store.py — circular).  Transitions
+# always run inside a store task, so the current store's node carries the
+# ring; bare Command objects (unit tests) record nothing.
+_current_store: Callable[[], Optional[object]] = lambda: None
+
+
+def note_status_transition(txn_id: TxnId, prev: SaveStatus,
+                           new: SaveStatus) -> None:
+    """Record a command status transition on the owning node's flight ring
+    (obs/flight.py).  Shared by Command.set_status and the few direct
+    save_status assignments in local.commands (supersession/truncation
+    paths that legally bypass the monotonicity check)."""
+    store = _current_store()
+    if store is None:
+        return
+    flight = getattr(store, "flight", None)
+    if flight is not None:
+        flight.record("status", repr(txn_id),
+                      (store.id, prev.name, new.name))
+
 
 class WaitingOn:
     """Bitsets over the stable deps AND the participating keys this command
@@ -233,7 +254,10 @@ class Command:
                 status.is_truncated,
                 "illegal status regression %s -> %s for %s",
                 self.save_status.name, status.name, self.txn_id)
+        prev = self.save_status
         self.save_status = status
+        if status is not prev:
+            note_status_transition(self.txn_id, prev, status)
 
     def update_route(self, route: Optional[Route]) -> None:
         if route is None:

@@ -18,7 +18,8 @@ from collections import deque
 from typing import Callable, List, Optional, Tuple
 
 from accord_tpu.local.cfk import InternalStatus
-from accord_tpu.local.command import Command, WaitingOn
+from accord_tpu.local.command import (Command, WaitingOn,
+                                      note_status_transition)
 from accord_tpu.local.status import Durability, SaveStatus
 from accord_tpu.local.store import SafeCommandStore
 from accord_tpu.primitives.deps import Deps, KeyDeps
@@ -272,6 +273,8 @@ def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId,
     # this is the one legal non-cleanup status "regression" (set_status
     # guards it), mirroring the reference's modelling of AcceptedInvalidate
     # as a fresh acceptance rather than a phase advance.
+    note_status_transition(cmd.txn_id, cmd.save_status,
+                           SaveStatus.ACCEPTED_INVALIDATE)
     cmd.save_status = SaveStatus.ACCEPTED_INVALIDATE
     return AcceptOutcome.SUCCESS
 
@@ -363,6 +366,7 @@ def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId) -> None:
             return
     if cmd.is_invalidated:
         return
+    note_status_transition(txn_id, cmd.save_status, SaveStatus.INVALIDATED)
     cmd.save_status = SaveStatus.INVALIDATED
     safe_store.store.insufficient_catchups.pop(txn_id, None)
     safe_store.register(cmd, InternalStatus.INVALID_OR_TRUNCATED)
@@ -814,5 +818,7 @@ def purge(safe_store: SafeCommandStore, txn_id: TxnId,
     if cmd.is_invalidated:
         pass  # keep INVALIDATED as terminal state
     else:
-        cmd.save_status = SaveStatus.ERASED if erase else SaveStatus.TRUNCATED_APPLY
+        target = SaveStatus.ERASED if erase else SaveStatus.TRUNCATED_APPLY
+        note_status_transition(txn_id, cmd.save_status, target)
+        cmd.save_status = target
     _notify_listeners(safe_store, cmd)

@@ -452,7 +452,12 @@ class Node:
                 pass  # slotted request without __dict__: not traceable
         if watched is not None and watched not in self._coordination_activity:
             watched = None
+        mt = request.type
+        verb = mt.label if mt is not None else type(request).__name__
+        flight = self.obs.flight
+        tid = getattr(request, "trace_id", None)
         for to in to_nodes:
+            flight.record("tx", tid, (to, verb))
             if callback is not None:
                 safe = _SafeCallback(self, to, callback, txn_id=watched)
                 safe.arm_timeout(timeout_s if timeout_s is not None
@@ -481,6 +486,10 @@ class Node:
         return topologies
 
     def reply(self, to: int, reply_context, reply: Reply) -> None:
+        mt = reply.type
+        self.obs.flight.record(
+            "reply", None,
+            (to, mt.label if mt is not None else type(reply).__name__))
         self.sink.reply(to, reply_context, reply)
 
     def receive(self, request: Request, from_id: int, reply_context) -> None:
@@ -495,11 +504,12 @@ class Node:
 
     def _process(self, request: Request, from_id: int, reply_context) -> None:
         tid = getattr(request, "trace_id", None)
+        mt = request.type
+        verb = mt.label if mt is not None else type(request).__name__
+        self.obs.flight.record("rx", tid, (from_id, verb))
         if tid is not None:
             # stitch this replica into the transaction's cross-node span
-            mt = request.type
-            self.obs.rx(tid, mt.label if mt is not None
-                        else type(request).__name__, from_id)
+            self.obs.rx(tid, verb, from_id)
         if self.journal is not None and request.type is not None \
                 and request.type.has_side_effects:
             self.journal.record(self.id, request)

@@ -503,6 +503,13 @@ class CommandStore:
         return self.node.agent
 
     @property
+    def flight(self):
+        """The owning node's flight recorder (obs/flight.py); None on
+        bare-store harnesses whose node stub carries no obs facade."""
+        obs = getattr(self.node, "obs", None)
+        return obs.flight if obs is not None else None
+
+    @property
     def data_store(self):
         return self.node.data_store
 
@@ -731,3 +738,11 @@ class CommandStores:
         results = [s.submit(context, map_fn) for s in stores]
         from accord_tpu.utils import async_chains
         return async_chains.reduce(results, reduce_fn)
+
+
+# rebind the flight-recorder hook in local.command (which cannot import this
+# module — store.py imports Command above): status transitions resolve the
+# store they run inside via CommandStore.current()
+from accord_tpu.local import command as _command_module  # noqa: E402
+
+_command_module._current_store = CommandStore.current

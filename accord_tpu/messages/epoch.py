@@ -59,6 +59,8 @@ class FetchSnapshot(Request):
     applied at the peer, its data for `ranges` contains every transaction
     ordered below the fence — snapshot and return it."""
 
+    type = MessageType.FETCH_DATA_REQ
+
     def __init__(self, txn_id: TxnId, ranges: Ranges):
         self.txn_id = txn_id  # the fence ESP
         self.ranges = ranges

@@ -14,9 +14,18 @@ with one process-local layer:
     optional `trace_id` attribute that `host/wire.py`'s structural codec
     round-trips for free), so a span stitches across replicas in sim and
     over TCP alike;
+  * `flight` — the always-on bounded flight recorder: one fixed-size ring
+    per node of status transitions / message tx-rx / escalations /
+    admission decisions, stitched across replicas into the failure
+    artifact when a burn or verify check goes red;
+  * `profiler` — kernel-level fenced wall timers, a jit-retrace ledger,
+    and the flush-window waterfall (sampled via `ACCORD_PROFILE=N`, off
+    by default; fences are injected by the device layer so this package
+    stays jax-free);
   * `node_obs.NodeObs` — the per-Node facade the engine instruments
-    against (one registry + one span store per node);
-  * `httpd` — the Prometheus-style text endpoint (`ACCORD_METRICS_PORT`);
+    against (one registry + one span store + one flight ring per node);
+  * `httpd` — the Prometheus-style text endpoint (`ACCORD_METRICS_PORT`)
+    plus the live `/flight?txn=` forensics view;
   * `report` — cross-node snapshot merging and the human summary the
     bench and burn harnesses record.
 
@@ -26,7 +35,11 @@ jitted code.  tests/test_obs_budget.py enforces this plus a <5% overhead
 bound on the scalar hot loop.
 """
 
+from accord_tpu.obs.flight import (EVENT_KINDS, FlightRecorder,
+                                   first_divergence, format_timeline,
+                                   stitch_flight, trace_ids_in_text)
 from accord_tpu.obs.node_obs import NodeObs
+from accord_tpu.obs.profiler import Profiler, profiler_from_env
 from accord_tpu.obs.registry import (Counter, Gauge, Histogram, Registry,
                                      parse_labels)
 from accord_tpu.obs.spans import (SpanStore, find_trace_ids, stitch,
@@ -34,7 +47,9 @@ from accord_tpu.obs.spans import (SpanStore, find_trace_ids, stitch,
 from accord_tpu.obs.views import CounterDict, MetricView, bind_metric_views
 
 __all__ = [
-    "Counter", "CounterDict", "Gauge", "Histogram", "MetricView", "NodeObs",
-    "Registry", "SpanStore", "bind_metric_views", "find_trace_ids",
-    "parse_labels", "stitch", "trace_key",
+    "Counter", "CounterDict", "EVENT_KINDS", "FlightRecorder", "Gauge",
+    "Histogram", "MetricView", "NodeObs", "Profiler", "Registry",
+    "SpanStore", "bind_metric_views", "find_trace_ids", "first_divergence",
+    "format_timeline", "parse_labels", "profiler_from_env", "stitch",
+    "stitch_flight", "trace_ids_in_text", "trace_key",
 ]

@@ -4,6 +4,8 @@
 
     GET /metrics        Prometheus text exposition
     GET /metrics.json   the NodeObs snapshot (metrics + summary), JSON
+    GET /flight         the node's flight-recorder tail (?limit=N), JSON
+    GET /flight?txn=ID  one trace id's flight events on this node, JSON
 
 Multi-process clusters on one machine offset the base port by the node id
 (node N binds base + N - 1); base 0 binds an ephemeral port (recorded on
@@ -30,6 +32,21 @@ class _Handler(BaseHTTPRequestHandler):
         obs = self.server.obs_provider()
         if self.path.startswith("/metrics.json"):
             body = json.dumps(obs.snapshot()).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/flight"):
+            from urllib.parse import parse_qs, urlparse
+            qs = parse_qs(urlparse(self.path).query)
+            txn = qs.get("txn", [None])[0]
+            try:
+                limit = int(qs.get("limit", ["200"])[0])
+            except ValueError:
+                limit = 200
+            flight = obs.flight
+            events = (flight.for_trace(txn) if txn
+                      else flight.tail(limit))
+            body = json.dumps({"node": obs.node_id, "txn": txn,
+                               "recorded_total": flight.recorded_total,
+                               "events": [list(e) for e in events]}).encode()
             ctype = "application/json"
         elif self.path.startswith("/metrics"):
             body = obs.registry.render_prometheus().encode()

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from accord_tpu.obs.flight import FlightRecorder
 from accord_tpu.obs.registry import Registry
 from accord_tpu.obs.spans import SpanStore, trace_key
 
@@ -30,16 +31,22 @@ ROUND_PHASES = frozenset({"preaccept", "preaccept_extend", "accept",
 class NodeObs:
     """Per-node metrics registry + span store + instrumentation helpers."""
 
-    __slots__ = ("node_id", "registry", "spans", "enabled", "_clock_us")
+    __slots__ = ("node_id", "registry", "spans", "flight", "enabled",
+                 "_clock_us")
 
     def __init__(self, node_id: int = 0, registry: Optional[Registry] = None,
                  clock_us: Optional[Callable[[], int]] = None,
-                 span_capacity: int = 4096, enabled: bool = True):
+                 span_capacity: int = 4096, enabled: bool = True,
+                 flight_capacity: int = 4096):
         self.node_id = node_id
         self.registry = registry if registry is not None else Registry()
         self.spans = SpanStore(node_id, capacity=span_capacity)
         self.enabled = enabled
         self._clock_us = clock_us if clock_us is not None else (lambda: 0)
+        # always-on bounded forensics ring (obs/flight.py) sharing the
+        # node's clock — stitched across replicas on failure
+        self.flight = FlightRecorder(node_id, capacity=flight_capacity,
+                                     clock_us=self._clock_us)
 
     def now_us(self) -> int:
         return int(self._clock_us())

@@ -48,7 +48,8 @@ class Pipeline:
         self.ingest = IngestQueue(
             scheduler if scheduler is not None else node.scheduler,
             self.batcher.coordinate_batch, self.config, self.stats,
-            trace=node.trace)
+            trace=node.trace,
+            flight=getattr(getattr(node, "obs", None), "flight", None))
 
     def submit(self, txn):
         """Admit one client transaction; returns its AsyncResult (settled

@@ -85,7 +85,7 @@ class IngestQueue:
 
     def __init__(self, scheduler, dispatch: Callable, config: PipelineConfig,
                  stats: Optional[PipelineStats] = None,
-                 trace=None):
+                 trace=None, flight=None):
         from accord_tpu.utils.tracing import NO_TRACE
         self.scheduler = scheduler
         self.dispatch = dispatch
@@ -93,6 +93,10 @@ class IngestQueue:
         self.stats = stats if stats is not None else PipelineStats()
         self.admission = AdmissionController(config.max_queue)
         self.trace = trace if trace is not None else NO_TRACE
+        # node's flight recorder (obs/flight.py); admission decisions land
+        # on the forensics ring so a shedding node's timeline explains a
+        # client's Rejected.  None on bare queues (unit tests).
+        self.flight = flight
         self._q: Deque[Admitted] = deque()
         self._timer = None
         self._deadline_us: Optional[int] = None
@@ -111,11 +115,15 @@ class IngestQueue:
             self.stats.record_shed()
             if self.trace.enabled:
                 self.trace.event("pipeline_shed", depth=len(self._q))
+            if self.flight is not None:
+                self.flight.record("pipeline_shed", None, (len(self._q),))
             result.try_failure(Rejected(
                 f"ingest queue full ({self.config.max_queue}); retry later"))
             return result
         self._q.append(Admitted(txn, result, self.now_us()))
         self.stats.record_admit(len(self._q))
+        if self.flight is not None:
+            self.flight.record("pipeline_admit", None, (len(self._q),))
         if len(self._q) >= self.config.max_batch:
             self._close(by_deadline=False)
         else:
@@ -175,6 +183,9 @@ class IngestQueue:
                                  depth=len(self._q),
                                  by_deadline=by_deadline,
                                  waited_us=waited)
+            if self.flight is not None:
+                self.flight.record("pipeline_batch", None,
+                                   (n, by_deadline))
             self.dispatch(batch)
             by_deadline = False  # only the first pop is deadline-credited
         # the admission-queue depth gauge tracks drains as well as admits

@@ -123,6 +123,18 @@ class BurnRun:
         # from reads, SCC cycle search, anomaly classification)
         from accord_tpu.sim.verify_replay import full_verifier
         self.verifier = full_verifier()
+        # failure forensics (obs/flight.py): acked results map their client
+        # txn_desc to the protocol trace id (ListResult carries the TxnId),
+        # so a checker Violation naming an observation stitches that txn's
+        # cross-replica flight timeline into the failure artifact
+        self.verifier.attach_forensics(self._forensics)
+        self._trace_of_desc: Dict[str, str] = {}
+        self.flight_artifact: Optional[str] = None
+        self._last_forensics_events = None
+        # test hook: mutate the observation list before verification (an
+        # injected invariant violation exercising the forensics path —
+        # tests/test_flight.py)
+        self.fault_injector = None
         self.stats = BurnStats()
         self.next_value = 0
         self._value_owner: Dict[int, dict] = {}
@@ -194,6 +206,9 @@ class BurnRun:
                 elif isinstance(value, ListResult):
                     self.stats.acks += 1
                     self.stats.ack_latencies_us.append(end_us - start_us)
+                    from accord_tpu.obs.spans import trace_key
+                    self._trace_of_desc[f"txn{idx}@n{origin}"] = \
+                        trace_key(value.txn_id)
                     reads = {k.token: v for k, v in value.read_values.items()}
                     if isinstance(txn.keys, Ranges):
                         # a range read asserts the FULL content of the window:
@@ -248,7 +263,9 @@ class BurnRun:
             f"op accounting leak: {self.stats} vs submitted={submitted[0]}"
 
         # final histories: majority agreement across replicas per key
-        final = self._final_histories()
+        final = self._with_flight_artifact(self._final_histories)
+        if self.fault_injector is not None:
+            self.fault_injector(observations)
         for obs in observations:
             self.verifier.observe(obs)
         self.verifier.verify(final)
@@ -258,7 +275,8 @@ class BurnRun:
         if self.cluster.journal is not None:
             from accord_tpu.sim.journal import validate_cluster
             self.journal_checked, self.journal_skipped = \
-                validate_cluster(self.cluster)
+                self._with_flight_artifact(
+                    lambda: validate_cluster(self.cluster))
         return self.stats
 
     # ---------------------------------------------------- observability --
@@ -275,6 +293,75 @@ class BurnRun:
         """Trace ids for which some node began a recovery coordination."""
         return self.cluster.find_trace_ids(phase="begin",
                                            path="recovery")
+
+    # ------------------------------------------------- failure forensics --
+    def flight_recorders(self):
+        return self.cluster.flight_recorders()
+
+    def stitched_flight(self, trace_ids=None, limit=None):
+        return self.cluster.stitched_flight(trace_ids=trace_ids,
+                                            limit=limit)
+
+    def _forensics(self, txn_descs) -> str:
+        """The verifiers' forensics hook (sim/verify.ForensicsMixin): map
+        the offending observations' client descriptions to their protocol
+        trace ids and stitch those transactions' flight events across every
+        replica into one causally ordered timeline — leading with the first
+        cross-replica status divergence when one exists."""
+        from accord_tpu.obs.flight import (first_divergence, format_timeline,
+                                           stitch_flight)
+        tids = {self._trace_of_desc.get(d) for d in txn_descs}
+        tids.discard(None)
+        if not tids:
+            return ""
+        events = stitch_flight(self.flight_recorders(), tids, limit=400)
+        self._last_forensics_events = events
+        parts = []
+        div = first_divergence(events)
+        if div is not None:
+            idx, at_i = div
+            def _tr(v):
+                return (f"s{v[0]}:{v[1]}->{v[2]}" if isinstance(v, tuple)
+                        and len(v) == 3 else "MISSING" if v is None
+                        else str(v))
+
+            parts.append(
+                f"first diverging event (status transition #{idx} "
+                f"per replica): "
+                + ", ".join(f"n{n}={_tr(v)}"
+                            for n, v in sorted(at_i.items())))
+        parts.append(format_timeline(
+            events, header=f"flight timeline (cross-replica) for "
+                           f"{sorted(tids)}:"))
+        self.flight_artifact = "\n".join(parts)
+        return self.flight_artifact
+
+    def _with_flight_artifact(self, fn):
+        """Run a verification step that has no observation context (journal
+        validation, replica-divergence detection); on failure, recover the
+        offending trace ids from the exception text (TxnId reprs ARE trace
+        ids) — or fall back to the recent cross-replica tail — and append
+        the stitched timeline to the raised error."""
+        try:
+            return fn()
+        except AssertionError as exc:
+            from accord_tpu.obs.flight import (format_timeline, stitch_flight,
+                                               trace_ids_in_text)
+            recorders = self.flight_recorders()
+            tids = trace_ids_in_text(recorders, str(exc))
+            if tids:
+                events = stitch_flight(recorders, tids, limit=400)
+                header = (f"flight timeline (cross-replica) for "
+                          f"{sorted(tids)}:")
+            else:
+                events = stitch_flight(recorders, None, limit=120)
+                header = ("flight timeline (cross-replica tail; no trace "
+                          "ids recovered from the failure):")
+            self._last_forensics_events = events
+            self.flight_artifact = format_timeline(events, header=header)
+            exc.args = ((f"{exc.args[0] if exc.args else exc}\n"
+                         f"{self.flight_artifact}"),)
+            raise
 
     def _has_unapplied_decided(self) -> bool:
         """Any stable-or-outcome-holding command still waiting to execute?"""
@@ -359,6 +446,13 @@ def main(argv=None) -> int:
     parser.add_argument("--metrics", action="store_true",
                         help="print the end-of-run obs report (merged "
                              "metrics registry summary, JSON)")
+    parser.add_argument("--flight-dump", action="store_true",
+                        help="print the stitched cross-replica flight-"
+                             "recorder tail after the run (the same view "
+                             "the failure artifact captures)")
+    parser.add_argument("--flight-txn", default=None,
+                        help="--flight-dump: filter to trace ids containing "
+                             "this substring")
     args = parser.parse_args(argv)
     if args.device_store or args.mesh_store:
         # the device store initialises jax: probe the (possibly
@@ -473,6 +567,15 @@ def main(argv=None) -> int:
         if args.metrics:
             import json as _json
             print("obs " + _json.dumps(run.metrics_snapshot()["summary"]))
+        if args.flight_dump:
+            from accord_tpu.obs.flight import format_timeline
+            tids = None
+            if args.flight_txn:
+                tids = {t for rec in run.flight_recorders()
+                        for t in rec.trace_ids() if args.flight_txn in t}
+            print(format_timeline(
+                run.stitched_flight(trace_ids=tids, limit=120),
+                header="flight (cross-replica tail):"))
         if args.message_stats:
             # per-verb delivery/drop counters (reference burn reports
             # messageStatsMap per message type, BurnTest.java:510+)

@@ -220,3 +220,14 @@ class SimCluster:
         from accord_tpu.obs.spans import find_trace_ids
         return find_trace_ids([n.obs.spans for n in self.nodes.values()],
                               phase=phase, **tags)
+
+    def flight_recorders(self):
+        return [n.obs.flight for n in self.nodes.values()]
+
+    def stitched_flight(self, trace_ids=None, limit=None):
+        """The cross-replica flight timeline (obs/flight.py): every node's
+        always-on event ring merged into causal order, optionally filtered
+        to a set of trace ids — the failure-forensics view."""
+        from accord_tpu.obs.flight import stitch_flight
+        return stitch_flight(self.flight_recorders(), trace_ids=trace_ids,
+                             limit=limit)

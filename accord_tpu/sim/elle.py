@@ -41,12 +41,13 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from accord_tpu.sim.verify import Observation, Violation, real_time_edges
+from accord_tpu.sim.verify import (ForensicsMixin, Observation, Violation,
+                                   real_time_edges)
 
 WW, WR, RW, RT = "ww", "wr", "rw", "realtime"
 
 
-class ElleListAppendChecker:
+class ElleListAppendChecker(ForensicsMixin):
     """Same observe/verify surface as the other two checkers."""
 
     def __init__(self):
@@ -96,10 +97,11 @@ class ElleListAppendChecker:
                         f"elle: value {value} appended to key {token} twice")
                 appender[(token, value)] = i
                 if value not in order.get(token, ()):
-                    raise Violation(
+                    raise self._violation(
                         f"elle: lost update — acked append of {value} to "
                         f"key {token} is absent from the version order "
-                        f"{order.get(token, ())} ({o})")
+                        f"{order.get(token, ())} ({o})",
+                        txn_descs=[o.txn_desc])
 
         # -- step 3+4: dependency edges (parallel adjacency by kind) --
         # node ids: 0..n-1 observations; values appended by no observed
@@ -150,9 +152,12 @@ class ElleListAppendChecker:
             kinds: Set[str] = set()
             for a, b in zip(cycle, cycle[1:] + cycle[:1]):
                 kinds |= edges.get((a, b), set())
-            raise Violation(
+            raise self._violation(
                 f"elle: {_classify(kinds, edges, cycle)} cycle over "
-                f"{[labels[i] for i in cycle]}")
+                f"{[labels[i] for i in cycle]}",
+                txn_descs=[labels[i] for i in cycle
+                           if isinstance(labels[i], str)
+                           and not labels[i].startswith("phantom(")])
 
     # introspection for tests: the checker found the history clean
     def __repr__(self):

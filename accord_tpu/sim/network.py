@@ -88,6 +88,17 @@ class SimNetwork:
     def _count(self, what: str) -> None:
         self.stats[what] = self.stats.get(what, 0) + 1
 
+    def _record_drop(self, from_id: int, to_id: int, message,
+                     msg_name: str) -> None:
+        """Drops are recorded on the SENDER's flight ring (the receiver
+        never saw the message; the sender's timeline is where the gap shows
+        up next to its tx event)."""
+        node = self.nodes.get(from_id)
+        obs = getattr(node, "obs", None)
+        if obs is not None:
+            obs.flight.record("drop", getattr(message, "trace_id", None),
+                              (from_id, to_id, msg_name))
+
     def deliver_request(self, from_id: int, to_id: int, request: Request,
                         reply_context) -> None:
         link = self.link(from_id, to_id)
@@ -95,6 +106,7 @@ class SimNetwork:
         msg_name = type(request).__name__
         if action == Action.DROP or self._filtered(from_id, to_id, request):
             self._count(f"drop.{msg_name}")
+            self._record_drop(from_id, to_id, request, msg_name)
             return
         self._count(f"deliver.{msg_name}")
         delay = (link.min_delay_us
@@ -117,6 +129,7 @@ class SimNetwork:
         if link.action(self.random) == Action.DROP \
                 or self._filtered(from_id, to_id, reply):
             self._count(f"drop.{type(reply).__name__}")
+            self._record_drop(from_id, to_id, reply, type(reply).__name__)
             return
         self._count(f"deliver.{type(reply).__name__}")
         delay = (link.min_delay_us

@@ -39,6 +39,36 @@ class Violation(AssertionError):
     pass
 
 
+class ForensicsMixin:
+    """Optional failure-forensics hook shared by the history checkers.
+
+    A harness that owns the cluster's flight recorders (sim/burn.py)
+    attaches a callable `forensics(txn_descs) -> str`; every Violation a
+    checker raises through `_violation` then carries the stitched
+    cross-replica flight timeline for the offending transactions instead
+    of (or in addition to) raw state dumps."""
+
+    forensics = None  # Callable[[List[str]], str] | None
+
+    def attach_forensics(self, fn) -> None:
+        self.forensics = fn
+
+    def _violation(self, detail: str, txn_descs=(),
+                   brief: Optional[str] = None) -> Violation:
+        """Build a Violation: `detail` alone without forensics; with a
+        forensics hook attached, `brief` (or detail) plus the stitched
+        flight timeline.  `brief` lets a checker drop raw state dicts when
+        the timeline supersedes them (sim/verify_replay.py)."""
+        if self.forensics is not None:
+            try:
+                extra = self.forensics(list(txn_descs))
+            except Exception:  # noqa: BLE001 — forensics must never mask
+                extra = ""     # the underlying violation
+            if extra:
+                return Violation(f"{brief or detail}\n{extra}")
+        return Violation(detail)
+
+
 def real_time_edges(obs: Sequence[Observation], add_edge) -> None:
     """Reduced real-time precedence: a -> every b starting in (end_a, m]
     where m is the minimum end among txns starting after end_a — any
@@ -67,7 +97,7 @@ def real_time_edges(obs: Sequence[Observation], add_edge) -> None:
             k += 1
 
 
-class StrictSerializabilityVerifier:
+class StrictSerializabilityVerifier(ForensicsMixin):
     def __init__(self):
         self.observations: List[Observation] = []
 
@@ -91,26 +121,30 @@ class StrictSerializabilityVerifier:
             for token, value in o.appends.items():
                 pos = positions.get((token, value))
                 if pos is None:
-                    raise Violation(
+                    raise self._violation(
                         f"lost append: {o} appended {value} to key {token} "
-                        f"but final history is {final_histories.get(token)}")
+                        f"but final history is {final_histories.get(token)}",
+                        txn_descs=[o.txn_desc])
                 dup = writer_of.get((token, pos))
                 if dup is not None:
-                    raise Violation(f"two txns own key {token} position {pos}")
+                    raise self._violation(
+                        f"two txns own key {token} position {pos}",
+                        txn_descs=[obs[dup].txn_desc, o.txn_desc])
                 writer_of[(token, pos)] = i
             for token, read in o.reads.items():
                 hist = tuple(final_histories.get(token, ()))
                 if tuple(read) != hist[:len(read)]:
-                    raise Violation(
+                    raise self._violation(
                         f"non-prefix read: {o} read {read} of key {token} "
-                        f"whose final history is {hist}")
+                        f"whose final history is {hist}",
+                        txn_descs=[o.txn_desc])
                 if token in o.appends:
                     pos = positions[(token, o.appends[token])]
                     if pos != len(read):
-                        raise Violation(
+                        raise self._violation(
                             f"non-atomic rmw: {o} read prefix of length "
                             f"{len(read)} of key {token} but its append landed "
-                            f"at position {pos}")
+                            f"at position {pos}", txn_descs=[o.txn_desc])
 
         # 4: constraint graph acyclicity
         edges: Dict[int, set] = {i: set() for i in range(n)}
@@ -162,7 +196,8 @@ class StrictSerializabilityVerifier:
                     queue.append(b)
         if seen != len(edges):
             cyclic = [self.observations[i] for i, d in indeg.items() if d > 0]
-            raise Violation(
+            raise self._violation(
                 "serialization cycle among "
                 f"{[o.txn_desc for o in cyclic[:10]]}"
-                f"{'...' if len(cyclic) > 10 else ''}")
+                f"{'...' if len(cyclic) > 10 else ''}",
+                txn_descs=[o.txn_desc for o in cyclic[:10]])

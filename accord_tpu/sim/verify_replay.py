@@ -28,7 +28,8 @@ from __future__ import annotations
 import heapq
 from typing import Dict, List, Sequence, Tuple
 
-from accord_tpu.sim.verify import Observation, Violation, real_time_edges
+from accord_tpu.sim.verify import (ForensicsMixin, Observation, Violation,
+                                   real_time_edges)
 
 
 class _Phantom:
@@ -44,7 +45,7 @@ class _Phantom:
         return f"Phantom({self.token}={self.value})"
 
 
-class WitnessReplayVerifier:
+class WitnessReplayVerifier(ForensicsMixin):
     """Same observe/verify surface as StrictSerializabilityVerifier."""
 
     def __init__(self):
@@ -125,9 +126,10 @@ class WitnessReplayVerifier:
         if len(witness) != total:
             stuck = [obs[i].txn_desc if i < n else phantoms[i - n]
                      for i in range(total) if indeg[i] > 0]
-            raise Violation(
+            raise self._violation(
                 f"no serial witness exists; cyclic constraints around "
-                f"{stuck[:10]}{'...' if len(stuck) > 10 else ''}")
+                f"{stuck[:10]}{'...' if len(stuck) > 10 else ''}",
+                txn_descs=[d for d in stuck[:10] if isinstance(d, str)])
 
         # -- model replay --
         state: Dict[int, List[int]] = {}
@@ -140,14 +142,21 @@ class WitnessReplayVerifier:
             for token, read in o.reads.items():
                 got = tuple(state.get(token, ()))
                 if tuple(read) != got:
-                    raise Violation(
+                    # with forensics attached the raw model-state dump is
+                    # superseded by the stitched flight timeline, which
+                    # leads with the first diverging cross-replica event
+                    raise self._violation(
                         f"witness replay mismatch: {o} read {read} of key "
-                        f"{token} but the model held {got}")
+                        f"{token} but the model held {got}",
+                        txn_descs=[o.txn_desc],
+                        brief=(f"witness replay mismatch: {o.txn_desc} "
+                               f"read key {token} diverges from the serial "
+                               f"witness"))
             for token, value in o.appends.items():
                 state.setdefault(token, []).append(value)
         for token, hist in final_histories.items():
             if tuple(state.get(token, ())) != tuple(hist):
-                raise Violation(
+                raise self._violation(
                     f"witness end-state mismatch on key {token}: model "
                     f"{state.get(token)} vs final {tuple(hist)}")
 
@@ -162,6 +171,13 @@ class CompositeVerifier:
     def observe(self, obs: Observation) -> None:
         for v in self.verifiers:
             v.observe(obs)
+
+    def attach_forensics(self, fn) -> None:
+        """Propagate the flight-timeline hook to every member checker
+        that supports it (sim/verify.ForensicsMixin)."""
+        for v in self.verifiers:
+            if hasattr(v, "attach_forensics"):
+                v.attach_forensics(fn)
 
     def verify(self, final_histories: Dict[int, Sequence[int]]) -> None:
         for v in self.verifiers:

@@ -41,10 +41,15 @@ import numpy as np
 PLATFORM = "unprobed"  # set by main() for device-using configs
 JSON_OUT = None        # optional path: emit() mirrors the JSON line there
 CONFIG = "default"     # set by main(); keys the regression-guard history
+LAST_RESULT = None     # emit() stashes the row for --guard's comparison
 ROWS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_DEVICE_ROWS.json")
-HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_HISTORY.json")
+# ACCORD_BENCH_HISTORY overrides the history file (guard tests exercise the
+# regression gate against a scratch history instead of the repo artifact)
+HISTORY_PATH = os.environ.get(
+    "ACCORD_BENCH_HISTORY",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_HISTORY.json"))
 
 
 def _platform_class(platform: str) -> str:
@@ -90,7 +95,17 @@ def _regression_guard(result: dict) -> None:
             # metrics snapshot rides with the BENCH row (fast-path ratio,
             # per-phase latency histograms, device flush-window counts)
             entry["obs"] = result["obs"]
-        history.setdefault(CONFIG, {})[pclass] = entry
+        if "profile" in result:
+            # per-kernel p50/p99 + retrace summary (obs/profiler.py):
+            # what `--guard` diffs against the last clean baseline
+            entry["profile"] = result["profile"]
+        lane = history.setdefault(CONFIG, {})
+        old = lane.get(pclass)
+        if old is not None:
+            # superseded rows are marked stale and retained (bounded), not
+            # deleted — the provenance of every re-baseline stays auditable
+            _supersede(lane, old, "overwritten by newer run")
+        lane[pclass] = entry
         # pid-unique tmp: the --fill loop and interactive runs may emit
         # concurrently; a shared tmp path could interleave truncated JSON
         tmp = f"{HISTORY_PATH}.tmp{os.getpid()}"
@@ -103,10 +118,22 @@ def _regression_guard(result: dict) -> None:
         pass
 
 
+def _supersede(lane: dict, entry: dict, reason: str) -> None:
+    """Retire a history row: stale-marked and appended to the lane's
+    bounded `superseded` list (ROADMAP: mark, don't delete)."""
+    old = dict(entry)
+    old["stale"] = True
+    old["stale_reason"] = reason
+    lane.setdefault("superseded", []).append(old)
+    del lane["superseded"][:-8]  # bounded provenance
+
+
 def emit(result: dict) -> None:
     """Print the one-line JSON contract; mirror to --json-out if set (the
     --fill orchestrator reads it back from the subprocess)."""
+    global LAST_RESULT
     _regression_guard(result)
+    LAST_RESULT = result
     line = json.dumps(result)
     print(line)
     if JSON_OUT:
@@ -185,6 +212,81 @@ def scalar_edges_per_sec(cfks, batch):
             by_key[k].map_reduce_active(tid, tid.kind.witnesses(), count)
     dt = time.perf_counter() - t0
     return edges / dt, edges
+
+
+def bench_scalar(n_keys=256, n_existing=8192, n_batch=128):
+    """Fast host-only config (never imports jax): the scalar active-scan
+    hot loop with a per-"kernel" profile, giving `--guard` a lane that can
+    run anywhere in seconds.  The profiled section is the same
+    CommandsForKey.map_reduce_active walk the device tier displaces."""
+    from accord_tpu.obs.profiler import Profiler
+    from accord_tpu.obs.registry import Registry
+
+    cfks, batch = build_world(n_keys=n_keys, n_existing=n_existing,
+                              n_batch=n_batch)
+    by_key = {c.key: c for c in cfks}
+    prof = Profiler(Registry(), sample_n=1)
+    edges = 0
+
+    def count(_):
+        nonlocal edges
+        edges += 1
+
+    t0 = time.perf_counter()
+    for tid, keyset in batch:
+        prof.window_begin(None)
+        t = prof.begin()
+        for k in keyset:
+            by_key[k].map_reduce_active(tid, tid.kind.witnesses(), count)
+        prof.lap(t, "scalar_scan")
+        prof.window_end()
+    dt = max(time.perf_counter() - t0, 1e-9)
+    emit({
+        "metric": "scalar_edges_resolved_per_sec",
+        "value": round(edges / dt, 1),
+        "unit": "edges/s",
+        "edges": edges,
+        "txns": n_batch,
+        "profile": prof.summary(),
+    })
+
+
+def _profile_device_kernels(args, reps: int = 24) -> dict:
+    """Per-kernel fenced wall profile for the device headline row: each
+    kernel timed individually, every lap ended by a host pull (the fence),
+    with the retrace ledger keyed by the argument shapes — the summary
+    bench records into the emitted row and BENCH_HISTORY (`--guard` input)."""
+    import jax.numpy as jnp
+
+    from accord_tpu.obs.profiler import Profiler
+    from accord_tpu.obs.registry import Registry
+    from accord_tpu.ops.deps_kernel import batched_active_deps, in_batch_graph
+    from accord_tpu.ops.wavefront import execution_waves
+
+    (er, eer, ek, es, ekd, tr, twm, tkd, touches) = args
+    prof = Profiler(Registry(), sample_n=1)
+    # warm-up compiles outside the timed laps (the ledger still counts the
+    # shape buckets — one compile per kernel at this shape)
+    prof.note_retrace("deps_kernel", (er.shape, touches.shape))
+    prof.note_retrace("in_batch_graph", (touches.shape,))
+    prof.note_retrace("wavefront", (touches.shape[0],))
+    np.asarray(batched_active_deps(er, eer, ek, es, ekd, tr, twm,
+                                   touches)[1])
+    g = in_batch_graph(tr, twm, tkd, touches)
+    np.asarray(execution_waves(g))
+    for _ in range(reps):
+        prof.window_begin(None)
+        t = prof.begin()
+        out = batched_active_deps(er, eer, ek, es, ekd, tr, twm, touches)
+        np.asarray(out[1])                       # host pull == fence
+        t = prof.lap(t, "deps_kernel")
+        g = in_batch_graph(tr, twm, tkd, touches)
+        g_host = np.asarray(g)
+        t = prof.lap(t, "in_batch_graph")
+        np.asarray(execution_waves(jnp.asarray(g_host)))
+        prof.lap(t, "wavefront")
+        prof.window_end()
+    return prof.summary()
 
 
 def _xla_window_body(entry_rank, entry_eat_rank, entry_key, entry_status,
@@ -291,6 +393,9 @@ def bench_default():
         "unit": "edges/s",
         "vs_baseline": round(device_eps / scalar_eps, 2),
         "platform": PLATFORM,
+        # per-kernel p50/p99 + retrace counts (obs/profiler.py) — the
+        # `--guard` regression gate's per-kernel input
+        "profile": _profile_device_kernels(args),
     }
     if PLATFORM.startswith("cpu"):
         # tunnel dead at capture time: point at the checkpointed on-chip
@@ -1101,6 +1206,124 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
     }))
 
 
+# ---------------------------------------------------------------- guard ----
+
+GUARD_PCT = 15.0  # per-kernel (and headline) regression threshold, percent
+
+
+def _load_history() -> dict:
+    try:
+        with open(HISTORY_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _guard_problems(current: dict, baseline: dict) -> list:
+    """Regressions of `current` vs the last clean `baseline` row: the
+    headline metric (direction-aware) and every per-kernel profile p50."""
+    problems = []
+    bval, cval = baseline.get("value"), current.get("value")
+    if isinstance(bval, (int, float)) and isinstance(cval, (int, float)) \
+            and bval:
+        pct = (cval - bval) / bval * 100.0
+        if CONFIG in LOWER_IS_BETTER:
+            pct = -pct
+        if pct < -GUARD_PCT:
+            problems.append(
+                f"headline {current.get('metric', CONFIG)}: {bval} -> "
+                f"{cval} ({pct:+.1f}%)")
+    bkern = (baseline.get("profile") or {}).get("kernels", {})
+    ckern = (current.get("profile") or {}).get("kernels", {})
+    for kernel, c in sorted(ckern.items()):
+        b = bkern.get(kernel)
+        if not b or not b.get("p50"):
+            continue
+        if c.get("p50", 0) > b["p50"] * (1 + GUARD_PCT / 100.0):
+            problems.append(
+                f"kernel {kernel}: p50 {b['p50']}us -> {c['p50']}us "
+                f"(+{(c['p50'] / b['p50'] - 1) * 100:.0f}%)")
+    return problems
+
+
+def _guard_baseline(result: dict):
+    """The last clean same-platform-class row for this config, captured by
+    emit() before it overwrote the entry (stale rows never gate)."""
+    prev = result.get("prev_same_platform")
+    if not prev or prev.get("stale"):
+        return None
+    return prev
+
+
+def run_guard(result: dict) -> int:
+    """`--guard`: diff the fresh row against the last clean baseline; on a
+    >GUARD_PCT regression restore the baseline (the failed row is retired
+    into `superseded` with stale+guard_failed marks) and exit nonzero."""
+    import sys
+    baseline = _guard_baseline(result)
+    if baseline is None:
+        print(f"# guard: no clean baseline for config={CONFIG}; "
+              f"recorded this run as the baseline", file=sys.stderr)
+        return 0
+    problems = _guard_problems(result, baseline)
+    if not problems:
+        print(f"# guard: OK vs baseline of unix={baseline.get('unix')}",
+              file=sys.stderr)
+        return 0
+    for p in problems:
+        print(f"# GUARD REGRESSION ({CONFIG}): {p}", file=sys.stderr)
+    # keep the history trustworthy: the regressed row must not become the
+    # next run's baseline
+    try:
+        pclass = _platform_class(result["platform"]) \
+            if result.get("platform") else "host"
+        history = _load_history()
+        lane = history.setdefault(CONFIG, {})
+        failed = lane.get(pclass)
+        if failed is not None:
+            failed = dict(failed)
+            failed["guard_failed"] = True
+            _supersede(lane, failed, "guard regression")
+        restored = dict(baseline)
+        restored.pop("stale", None)
+        restored.pop("stale_reason", None)
+        lane[pclass] = restored
+        tmp = f"{HISTORY_PATH}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(history, f, indent=1)
+        os.replace(tmp, HISTORY_PATH)
+    except OSError:
+        pass
+    return 2
+
+
+def run_guard_dry(config: str) -> int:
+    """`--guard --dry-run`: no workload — parse the history, find this
+    config's rows, and diff each against itself (zero regressions by
+    construction).  Exercises the whole guard parsing path so schema rot
+    in BENCH_HISTORY.json fails fast in CI."""
+    history = _load_history()
+    lane = history.get(config, {})
+    checked = []
+    for pclass, entry in lane.items():
+        if pclass == "superseded" or not isinstance(entry, dict):
+            continue
+        probe = dict(entry)
+        probe["metric"] = config
+        probe["prev_same_platform"] = entry
+        assert not _guard_problems(probe, entry), \
+            f"self-diff of {config}/{pclass} reported a regression"
+        checked.append({
+            "pclass": pclass, "value": entry.get("value"),
+            "stale_superseded": len(lane.get("superseded", [])),
+            "profile_kernels": sorted(
+                (entry.get("profile") or {}).get("kernels", {})),
+        })
+    print(json.dumps({"metric": f"{config}_guard", "dry_run": True,
+                      "history": HISTORY_PATH, "baselines": checked}))
+    return 0
+
+
 # ----------------------------------------------------------------- fill ----
 
 # device configs cheapest-first with generous per-config subprocess
@@ -1209,7 +1432,17 @@ def main():
     ap.add_argument("--config", default="default",
                     choices=["default", "zipf1m", "rangestress", "tpcc",
                              "maelstrom", "maelstrom-rw", "tcp",
-                             "pipeline"])
+                             "pipeline", "scalar"])
+    ap.add_argument("--guard", action="store_true",
+                    help="after the run, diff the row (headline + per-"
+                         "kernel profile p50s) against the last clean "
+                         "baseline in BENCH_HISTORY.json; exit 2 on a "
+                         ">15%% regression (the failed row is retired as "
+                         "stale, the baseline restored)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="--guard only: skip the workload, parse the "
+                         "history and self-diff this config's rows (CI "
+                         "smoke for guard-mode parsing)")
     ap.add_argument("--verify", action="store_true",
                     help="cross-check device window counts against a host "
                          "re-derivation (zipf1m)")
@@ -1237,7 +1470,10 @@ def main():
         missing = fill_device_rows(ns.max_wait, only)
         print(f"# fill done; {missing} configs still missing")
         raise SystemExit(0 if missing == 0 else 1)
-    if ns.config not in ("maelstrom", "maelstrom-rw", "tcp", "pipeline"):
+    if ns.dry_run:
+        raise SystemExit(run_guard_dry(CONFIG))
+    if ns.config not in ("maelstrom", "maelstrom-rw", "tcp", "pipeline",
+                         "scalar"):
         # device-using configs probe the (possibly dead-tunneled) backend
         # first; host-only configs never touch the chip
         from accord_tpu.utils.backend import resolve_platform
@@ -1256,8 +1492,12 @@ def main():
         bench_tcp(nodes=3, keys=100)
     elif ns.config == "pipeline":
         bench_pipeline(nodes=3, keys=100)
+    elif ns.config == "scalar":
+        bench_scalar()
     else:
         bench_rangestress()
+    if ns.guard:
+        raise SystemExit(run_guard(LAST_RESULT) if LAST_RESULT else 0)
 
 
 if __name__ == "__main__":

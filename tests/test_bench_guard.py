@@ -1,0 +1,64 @@
+"""bench.py guard-mode smokes (CI satellite of the profiler tentpole).
+
+Fast, jax-free: the `scalar` config runs the scalar active-scan hot loop
+in seconds, and `--guard --dry-run` parses the checked-in
+BENCH_HISTORY.json without running any workload — so guard-mode parsing
+of the history schema cannot rot unnoticed."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(args, env_extra=None, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable, BENCH, *args], cwd=REPO,
+                          env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_guard_dry_run_parses_checked_in_history():
+    """The committed BENCH_HISTORY.json must stay guard-parseable: the
+    dry run self-diffs every lane of the scalar config and reports the
+    baselines it would gate against."""
+    proc = _run(["--config", "scalar", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "scalar_guard" and row["dry_run"] is True
+    assert row["baselines"], "no scalar baseline in BENCH_HISTORY.json"
+    assert "scalar_scan" in row["baselines"][0]["profile_kernels"]
+
+
+def test_guard_exits_nonzero_on_synthetic_2x_kernel_slowdown(tmp_path):
+    """ISSUE 3 acceptance: --guard must exit nonzero when the per-kernel
+    profile regresses 2x vs the recorded baseline (synthesized via the
+    profiler's ACCORD_PROFILE_SCALE test hook against a scratch history)."""
+    hist = str(tmp_path / "hist.json")
+    first = _run(["--config", "scalar", "--guard"],
+                 {"ACCORD_BENCH_HISTORY": hist})
+    assert first.returncode == 0, first.stderr
+    assert "no clean baseline" in first.stderr
+    slow = _run(["--config", "scalar", "--guard"],
+                {"ACCORD_BENCH_HISTORY": hist, "ACCORD_PROFILE_SCALE": "2"})
+    assert slow.returncode != 0, (slow.stdout, slow.stderr)
+    assert "GUARD REGRESSION" in slow.stderr
+    assert "scalar_scan" in slow.stderr
+    # the regressed row was retired (stale + guard_failed), the clean
+    # baseline restored — a failed run must not become the next baseline
+    lane = json.load(open(hist))["scalar"]
+    assert "guard_failed" not in lane["host"]
+    assert any(e.get("guard_failed") and e.get("stale")
+               for e in lane["superseded"])
+    # and a definitely-not-regressed re-run (scale 0.5 halves measured
+    # laps, so scheduler noise cannot cross the +15% gate) passes against
+    # the restored baseline
+    ok = _run(["--config", "scalar", "--guard"],
+              {"ACCORD_BENCH_HISTORY": hist, "ACCORD_PROFILE_SCALE": "0.5"})
+    assert "kernel scalar_scan" not in ok.stderr, ok.stderr

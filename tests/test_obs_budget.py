@@ -137,3 +137,99 @@ def test_obs_overhead_under_5pct_of_scalar_hot_loop():
     assert ratio < 0.05, (
         f"obs bundle {obs_us:.1f}us vs scalar hot loop {loop_us:.1f}us "
         f"per txn: {ratio:.1%} >= 5% budget")
+
+
+# ------------------------------------------------ flight-recorder budget ----
+
+def _flight_txn_bundle_cost_us(reps=400):
+    """min-of-3 per-txn cost of the always-on flight events ONE node
+    records for one fast-path rf=3 write — more than a real node sees,
+    since coordinator tx fan-out AND replica rx/status traffic are both
+    charged to the same bundle here: 8 tx + 2 rx + 2 reply + 6 status
+    transitions, with the trace-id repr() paid per status event exactly as
+    local/command.note_status_transition pays it."""
+    from accord_tpu.obs.flight import FlightRecorder
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    flight = FlightRecorder(1, clock_us=lambda: 0)
+    tids = [TxnId.create(1, 10_000 + i, TxnKind.WRITE, Domain.KEY, 1)
+            for i in range(reps)]
+    statuses = ("NOT_DEFINED", "PRE_ACCEPTED", "ACCEPTED", "COMMITTED",
+                "STABLE", "APPLIED")
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for tid in tids:
+            key = repr(tid)
+            for to in (1, 2, 3):
+                flight.record("tx", key, (to, "PRE_ACCEPT_REQ"))
+            for to in (1, 2, 3):
+                flight.record("tx", key, (to, "STABLE_FAST_PATH_REQ"))
+            flight.record("tx", key, (2, "READ_REQ"))
+            flight.record("tx", key, (3, "APPLY_MINIMAL_REQ"))
+            flight.record("rx", key, (2, "PRE_ACCEPT_REQ"))
+            flight.record("rx", key, (3, "APPLY_MINIMAL_REQ"))
+            flight.record("reply", None, (1, "SIMPLE_RSP"))
+            flight.record("reply", None, (1, "READ_RSP"))
+            for prev, new in zip(statuses, statuses[1:]):
+                flight.record("status", repr(tid), (0, prev, new))
+        dt = (time.perf_counter() - t0) / reps * 1e6
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def test_flight_recorder_overhead_under_2pct_of_scalar_hot_loop():
+    """ISSUE 3 acceptance: the ALWAYS-ON flight recorder must cost <2% of
+    the scalar hot loop (rf=3 x 1024-entry active scans) per transaction."""
+    flight_us = _flight_txn_bundle_cost_us()
+    loop_us = _scalar_hot_loop_cost_us()
+    ratio = flight_us / loop_us
+    assert ratio < 0.02, (
+        f"flight bundle {flight_us:.1f}us vs scalar hot loop "
+        f"{loop_us:.1f}us per txn: {ratio:.1%} >= 2% budget")
+
+
+def test_flight_ring_is_bounded():
+    from accord_tpu.obs.flight import FlightRecorder
+    fl = FlightRecorder(1, capacity=64, clock_us=lambda: 0)
+    for i in range(1000):
+        fl.record("tx", None, (1, "READ_REQ"))
+    assert len(fl) == 64 and fl.recorded_total == 1000
+
+
+# ------------------------------------------------- profiler-off budget ----
+
+def _profiler_off_bundle_cost_us(reps=2000):
+    """min-of-3 per-'window' cost of the profiler entry points with
+    ACCORD_PROFILE unset (disabled): the exact call pattern a device flush
+    window executes — window_begin, 4 begin/3-lap kernel sections,
+    window_end, plus the always-on retrace-ledger lookup."""
+    from accord_tpu.obs.profiler import Profiler
+    from accord_tpu.obs.registry import Registry
+    prof = Profiler(Registry(), sample_n=0)  # off: the default
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            prof.note_retrace("deps", ((1024,), (128, 256)))
+            prof.window_begin(None)
+            for _section in range(4):
+                t = prof.begin()
+                t = prof.lap(t, "deps_encode", stage="encode")
+                t = prof.lap(t, "deps_kernel", stage="device")
+                prof.lap(t, "deps_decode", stage="decode")
+            prof.window_end()
+        dt = (time.perf_counter() - t0) / reps * 1e6
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def test_profiler_off_overhead_under_2pct_of_scalar_hot_loop():
+    """ISSUE 3 satellite: with profiling off (the hot-path default), the
+    profiler hooks on the flush path must cost <2% of the scalar hot loop
+    per window."""
+    prof_us = _profiler_off_bundle_cost_us()
+    loop_us = _scalar_hot_loop_cost_us()
+    ratio = prof_us / loop_us
+    assert ratio < 0.02, (
+        f"profiler-off bundle {prof_us:.2f}us vs scalar hot loop "
+        f"{loop_us:.1f}us: {ratio:.1%} >= 2% budget")

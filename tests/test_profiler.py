@@ -1,0 +1,132 @@
+"""Kernel-level profiler (accord_tpu/obs/profiler.py): sampling gates,
+lap/waterfall mechanics, the always-on retrace ledger, and the live wiring
+through the device store's flush windows under ACCORD_PROFILE."""
+
+import pytest
+
+from accord_tpu.obs.profiler import Profiler, profiler_from_env
+from accord_tpu.obs.registry import Registry
+
+
+def test_disabled_profiler_is_inert():
+    reg = Registry()
+    prof = Profiler(reg, sample_n=0)
+    assert prof.window_begin(None) is False
+    t = prof.begin()
+    assert t is None
+    assert prof.lap(t, "deps_kernel") is None
+    prof.window_end()
+    assert reg.find_histograms("accord_profile_kernel_us") == []
+    # the retrace ledger stays on even with timing off
+    prof.note_retrace("deps", ((8,), (2, 4)))
+    prof.note_retrace("deps", ((8,), (2, 4)))
+    prof.note_retrace("deps", ((16,), (2, 4)))
+    assert reg.value("accord_profile_retraces_total", kernel="deps") == 2
+    assert prof.summary()["retraces"] == {"deps": 2}
+
+
+def test_sampling_one_in_n_windows():
+    reg = Registry()
+    prof = Profiler(reg, sample_n=3, clock=lambda: 0.0)
+    sampled = [prof.window_begin(None) for _ in range(9)]
+    assert sum(sampled) == 3
+
+
+def test_laps_and_waterfall_feed_registry_and_summary():
+    reg = Registry()
+    ticks = iter(range(0, 1000))
+    prof = Profiler(reg, sample_n=1, clock=lambda: next(ticks) * 1e-3)
+    prof.window_begin(opened_at=-0.002)     # queue-wait >= 2ms
+    t = prof.begin()
+    t = prof.lap(t, "deps_encode", stage="encode")
+    t = prof.lap(t, "deps_kernel", stage="device")
+    prof.lap(t, "deps_decode", stage="decode")
+    prof.window_end()
+    s = prof.summary()
+    assert set(s["kernels"]) == {"deps_encode", "deps_kernel",
+                                 "deps_decode"}
+    for rec in s["kernels"].values():
+        assert rec["count"] == 1 and rec["p50"] >= 999  # 1ms ticks
+        assert rec["p99"] >= rec["p50"]
+    stages = {h.labels["stage"]
+              for h in reg.find_histograms("accord_profile_window_us")}
+    assert stages == {"queue_wait", "encode", "device", "decode"}
+    assert reg.value("accord_profile_windows_sampled_total") == 1
+
+
+def test_lap_runs_injected_fence_inside_the_lap():
+    reg = Registry()
+    clock = {"now": 0.0}
+    prof = Profiler(reg, sample_n=1, clock=lambda: clock["now"])
+    prof.window_begin(None)
+    t = prof.begin()
+
+    def fence():
+        clock["now"] += 0.5  # the sync wait belongs to the kernel lap
+
+    prof.lap(t, "deps_kernel", fence=fence)
+    assert prof.summary()["kernels"]["deps_kernel"]["p50"] >= 0.5e6
+
+
+def test_profile_scale_env_hook(monkeypatch):
+    monkeypatch.setenv("ACCORD_PROFILE_SCALE", "2")
+    ticks = iter(range(0, 100))
+    prof = Profiler(Registry(), sample_n=1, clock=lambda: next(ticks) * 1e-3)
+    prof.window_begin(None)
+    prof.lap(prof.begin(), "k")
+    assert prof.summary()["kernels"]["k"]["p50"] == pytest.approx(2000.0)
+
+
+def test_profiler_from_env(monkeypatch):
+    monkeypatch.delenv("ACCORD_PROFILE", raising=False)
+    assert not profiler_from_env(Registry()).enabled
+    monkeypatch.setenv("ACCORD_PROFILE", "4")
+    p = profiler_from_env(Registry())
+    assert p.enabled and p.sample_n == 4
+    monkeypatch.setenv("ACCORD_PROFILE", "garbage")
+    assert not profiler_from_env(Registry()).enabled
+
+
+# ------------------------------------------------------- device wiring ----
+
+def test_device_store_flush_windows_profile_under_accord_profile(monkeypatch):
+    """ACCORD_PROFILE=1 on a device-store burn: every flush window is
+    sampled — the deps waterfall (encode/device/decode), per-kernel
+    histograms and the retrace ledger all land in the node registries."""
+    monkeypatch.setenv("ACCORD_PROFILE", "1")
+    from accord_tpu.impl.device_store import DeviceCommandStore
+    from accord_tpu.sim.burn import BurnRun
+    run = BurnRun(13, 30, durability=False, topology_changes=False,
+                  store_factory=DeviceCommandStore.factory(
+                      flush_window_us=300, verify=True))
+    stats = run.run()
+    assert stats.acks > 0
+    merged = run.metrics_snapshot()["metrics"]
+    kernels = merged["histograms"].get("accord_profile_kernel_us", {})
+    assert any("deps_kernel" in lk for lk in kernels), kernels.keys()
+    assert any("deps_encode" in lk for lk in kernels)
+    windows = merged["histograms"].get("accord_profile_window_us", {})
+    got_stages = {lk for lk in windows}
+    assert any("queue_wait" in lk for lk in got_stages), got_stages
+    assert any("device" in lk for lk in got_stages)
+    retr = merged["counters"].get("accord_profile_retraces_total", {})
+    assert sum(retr.values()) >= 1, retr
+    sampled = merged["counters"].get(
+        "accord_profile_windows_sampled_total", {})
+    assert sum(sampled.values()) > 0
+
+
+def test_device_store_profiler_off_by_default():
+    """Without ACCORD_PROFILE the hot path records no timing histograms
+    (the <2%-overhead contract in test_obs_budget.py presumes this)."""
+    from accord_tpu.impl.device_store import DeviceCommandStore
+    from accord_tpu.sim.burn import BurnRun
+    run = BurnRun(13, 20, durability=False, topology_changes=False,
+                  store_factory=DeviceCommandStore.factory(
+                      flush_window_us=300))
+    run.run()
+    merged = run.metrics_snapshot()["metrics"]
+    assert "accord_profile_kernel_us" not in merged["histograms"]
+    # ...but the retrace ledger is always on
+    retr = merged["counters"].get("accord_profile_retraces_total", {})
+    assert sum(retr.values()) >= 1

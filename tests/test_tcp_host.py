@@ -108,3 +108,38 @@ def test_tcp_cluster_with_device_stores(monkeypatch):
     finally:
         for h in hosts.values():
             h.close()
+
+
+@pytest.mark.slow
+def test_flight_frame_over_tcp_cluster():
+    """The live forensics view over the frame transport: a client pulls a
+    node's flight-recorder ring with a {"type": "flight"} frame — both the
+    tail and one trace id's filtered events."""
+    from accord_tpu.host.tcp import TcpClusterClient
+    c = TcpClusterClient(n_nodes=2)
+    try:
+        c.submit(1, [5], {5: 1}, req=0)
+        deadline_ok = False
+        import time
+        end = time.monotonic() + 60
+        while time.monotonic() < end:
+            frame = c.recv(5.0)
+            body = (frame or {}).get("body", {})
+            if body.get("type") == "submit_reply" and body.get("req") == 0:
+                deadline_ok = body["ok"]
+                break
+        assert deadline_ok, "submit did not complete"
+        view = c.fetch_flight(1)
+        assert view is not None and view["node"] == 1
+        events = view["events"]
+        assert events and view["recorded_total"] >= len(events)
+        kinds = {e[2] for e in events}
+        assert "rx" in kinds or "tx" in kinds
+        # filter one traced event's id through the txn= arm
+        tids = [e[3] for e in events if e[3]]
+        assert tids, "no traced events on the ring"
+        one = c.fetch_flight(1, txn=tids[-1])
+        assert one["events"] and all(e[3] == tids[-1]
+                                     for e in one["events"])
+    finally:
+        c.close()
