@@ -144,12 +144,19 @@ class MaelstromHost:
         self._msg_seq = 0
         self.running = True
         self._pre_init: list = []
+        self.wal = None  # ACCORD_JOURNAL: attached in _build_node
+        # stdout is shared by the loop thread and (with a journal in
+        # group-commit mode) the WAL flush thread releasing durability-
+        # gated replies: envelope writes must not interleave
+        import threading
+        self._emit_lock = threading.Lock()
 
     # ------------------------------------------------------------- output --
     def _emit(self, dest: str, body: dict) -> None:
-        print(json.dumps({"src": self.node_name, "dest": dest,
-                          "body": body}),
-              file=self.stdout, flush=True)
+        with self._emit_lock:
+            print(json.dumps({"src": self.node_name, "dest": dest,
+                              "body": body}),
+                  file=self.stdout, flush=True)
 
     def emit_node(self, to: int, body: dict) -> None:
         self._emit(self.names.get(to, f"n{to}"), body)
@@ -170,6 +177,11 @@ class MaelstromHost:
                          num_shards=1,
                          now_us=lambda: int(time.time() * 1e6))
         self.node.on_topology_update(topology)
+        # ACCORD_JOURNAL=<dir>: replay surviving state from
+        # <dir>/node-<id>, then journal every side-effecting request before
+        # it is acked (group-commit fsync windows; see journal/wal.py)
+        from accord_tpu.journal import attach_journal_from_env
+        self.wal = attach_journal_from_env(self.node)
         # ACCORD_PIPELINE=1: continuous micro-batching ingest (same layer
         # the TCP host wires; see accord_tpu/pipeline/).  Default off.
         from accord_tpu.pipeline import (Pipeline, PipelineConfig,
@@ -326,6 +338,8 @@ class MaelstromHost:
                 if coalesce:
                     self.sink.batch_flush()
             self.scheduler.run_due()
+        if self.wal is not None:
+            self.wal.close()  # final fsync on clean shutdown
 
 
 def main():

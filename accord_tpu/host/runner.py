@@ -76,12 +76,20 @@ class MaelstromRunner:
     """Drives N host processes; acts as all Maelstrom clients at once."""
 
     def __init__(self, n_nodes: int = 3, seed: int = 0,
-                 pipeline: bool = False):
+                 pipeline: bool = False, journal_dir: Optional[str] = None):
         self.names = [f"n{i + 1}" for i in range(n_nodes)]
         self.inbox: "queue.Queue" = queue.Queue()
         # pipeline=True turns on the continuous micro-batching ingest layer
-        # in every node process (accord_tpu/pipeline/, ACCORD_PIPELINE=1)
-        extra_env = {"ACCORD_PIPELINE": "1"} if pipeline else None
+        # in every node process (accord_tpu/pipeline/, ACCORD_PIPELINE=1);
+        # journal_dir points every node process at a durable write-ahead
+        # journal (ACCORD_JOURNAL; accord_tpu/journal/), which also makes
+        # restart_node a black-box crash test: kill -9 + respawn + replay
+        self._extra_env: Dict[str, str] = {}
+        if pipeline:
+            self._extra_env["ACCORD_PIPELINE"] = "1"
+        if journal_dir is not None:
+            self._extra_env["ACCORD_JOURNAL"] = journal_dir
+        extra_env = self._extra_env or None
         self.procs: Dict[str, HostProcess] = {
             name: HostProcess(name, self.inbox, extra_env=extra_env)
             for name in self.names}
@@ -90,6 +98,10 @@ class MaelstromRunner:
         self.pending: Dict[int, dict] = {}   # msg_id -> op record
         self.results: List[dict] = []
         self.init_acks: set = set()
+        # appended values must be unique across the runner's LIFETIME, not
+        # per workload call: a crash-restart harness runs several phases
+        # against the same cluster and verifies them together
+        self._next_value = 0
 
     # ----------------------------------------------------------- plumbing --
     def _route(self, envelope: dict) -> None:
@@ -132,6 +144,31 @@ class MaelstromRunner:
             self.pump()
         return predicate()
 
+    # ------------------------------------------------------- crash-restart --
+    def restart_node(self, name: str, deadline_s: float = 60.0) -> None:
+        """Black-box crash-restart: SIGKILL the node process (no shutdown
+        hook runs — true process death), respawn it with the same identity
+        and environment, and re-init it.  With a journal_dir the replica
+        replays its on-disk WAL before serving; without one this is a
+        data-loss crash (useful as the negative control)."""
+        hp = self.procs[name]
+        hp.proc.kill()
+        try:
+            hp.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        self.init_acks.discard(name)
+        self.procs[name] = HostProcess(name, self.inbox,
+                                       extra_env=self._extra_env or None)
+        self._msg_seq += 1
+        self.procs[name].send({"src": "c0", "dest": name,
+                               "body": {"type": "init",
+                                        "msg_id": self._msg_seq,
+                                        "node_id": name,
+                                        "node_ids": self.names}})
+        ok = self.pump_until(lambda: name in self.init_acks, deadline_s)
+        assert ok, f"restarted {name} never re-initialized"
+
     # ------------------------------------------------------------- client --
     def init_all(self) -> None:
         for name, hp in self.procs.items():
@@ -169,36 +206,39 @@ class MaelstromRunner:
         `single_key` restricts every txn to one key (the lin-kv shape);
         the default mixes multi-key RMWs (txn-rw-register)."""
         import random
-        rng = random.Random(self.seed)
-        next_value = [0]
+        rng = random.Random(self.seed + self._next_value)
         submitted = [0]
+        base = len(self.results)  # completions are counted per phase
 
         def submit_one():
             client = f"c{1 + rng.randrange(4)}"
             k = rng.randrange(n_keys)
             ops = [["r", k, None]]
             if rng.random() < 0.7:
-                next_value[0] += 1
-                ops.append(["append", k, next_value[0]])
+                self._next_value += 1
+                ops.append(["append", k, self._next_value])
             if not single_key and rng.random() < 0.3:
                 k2 = rng.randrange(n_keys)
                 if not any(o == "append" and ok == k2 for o, ok, _ in ops):
-                    next_value[0] += 1
-                    ops.append(["append", k2, next_value[0]])
+                    self._next_value += 1
+                    ops.append(["append", k2, self._next_value])
             self.submit_txn(client, ops)
             submitted[0] += 1
+
+        def completed() -> int:
+            return len(self.results) - base
 
         for _ in range(min(pipeline, n_ops)):
             submit_one()
         end = time.monotonic() + deadline_s
-        while len(self.results) < n_ops and time.monotonic() < end:
+        while completed() < n_ops and time.monotonic() < end:
             self.pump()
             while submitted[0] < n_ops \
-                    and submitted[0] - len(self.results) < pipeline:
+                    and submitted[0] - completed() < pipeline:
                 submit_one()
-        ok = sum(1 for r in self.results
+        ok = sum(1 for r in self.results[base:]
                  if r["reply"] and r["reply"].get("type") == "txn_ok")
-        return {"submitted": submitted[0], "completed": len(self.results),
+        return {"submitted": submitted[0], "completed": completed(),
                 "acked": ok}
 
     # -------------------------------------------------------------- verify --

@@ -266,6 +266,14 @@ class TcpHost:
                          now_us=lambda: int(time.time() * 1e6))
         self.node.on_topology_update(topology)
 
+        # ACCORD_JOURNAL=<dir>: durable write-ahead journal under
+        # <dir>/node-<id> — existing state replays into the node BEFORE any
+        # peer traffic is accepted, every side-effecting request is
+        # journaled before its ack, and (group-commit mode) acks are gated
+        # on the covering fsync by DurableAckSink.  Default off.
+        from accord_tpu.journal import attach_journal_from_env
+        self.wal = attach_journal_from_env(self.node)
+
         # ACCORD_PIPELINE=1: continuous micro-batching ingest — client
         # submissions coalesce into deadline-bounded batches whose fan-out
         # leaves as one MultiPreAccept envelope per replica (and whose
@@ -485,6 +493,11 @@ class TcpHost:
 
     def close(self) -> None:
         self.running = False
+        if self.wal is not None:
+            try:
+                self.wal.close()  # final fsync: nothing acked is lost
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
         if self.metrics_server is not None:
             try:
                 self.metrics_server.shutdown()

@@ -28,6 +28,10 @@ _MODULES = [
     "accord_tpu.primitives.writes",
     "accord_tpu.local.status",
     "accord_tpu.local.command",
+    # only AcceptOutcome/ApplyOutcome enums: AcceptNack carries its reason
+    # (found by tests/test_wire_roundtrip.py — the nack encoded but could
+    # not decode, so a slow-path reject crashed the receiving host)
+    "accord_tpu.local.commands",
     "accord_tpu.messages.base",
     "accord_tpu.messages.preaccept",
     "accord_tpu.messages.accept",
@@ -53,6 +57,9 @@ _MODULES = [
 
 _CLASSES: Dict[str, Type] = {}
 _ENUMS: Dict[str, Type] = {}
+import threading as _threading
+
+_REGISTRY_LOCK = _threading.Lock()
 
 # compact fast paths for the primitives that dominate every frame (a deps
 # list is hundreds of TxnIds; the structural walk also serialises cached
@@ -73,15 +80,26 @@ _SLOTS_CACHE: Dict[Type, list] = {}
 def _registry() -> Dict[str, Type]:
     if _CLASSES:
         return _CLASSES
-    for mod_name in _MODULES:
-        mod = importlib.import_module(mod_name)
-        for name, obj in vars(mod).items():
-            if not isinstance(obj, type) or obj.__module__ != mod_name:
-                continue
-            if issubclass(obj, enum.Enum):
-                _ENUMS[name] = obj
-            else:
-                _CLASSES[name] = obj
+    # build-then-publish under a lock: encoders run concurrently (node loop
+    # thread + the WAL's group-commit flush thread releasing gated replies,
+    # or many bench appenders), and a reader racing a partial in-place
+    # population would reject registered types as unknown
+    with _REGISTRY_LOCK:
+        if _CLASSES:
+            return _CLASSES
+        classes: Dict[str, Type] = {}
+        enums: Dict[str, Type] = {}
+        for mod_name in _MODULES:
+            mod = importlib.import_module(mod_name)
+            for name, obj in vars(mod).items():
+                if not isinstance(obj, type) or obj.__module__ != mod_name:
+                    continue
+                if issubclass(obj, enum.Enum):
+                    enums[name] = obj
+                else:
+                    classes[name] = obj
+        _ENUMS.update(enums)
+        _CLASSES.update(classes)
     return _CLASSES
 
 

@@ -52,6 +52,17 @@ EVENT_KINDS = {
                      "(pipeline/ingest.py); data=(queue_depth,)",
     "pipeline_batch": "ingest batch closed (pipeline/ingest.py); "
                       "data=(size, by_deadline)",
+    "journal_append": "WAL record framed into the active segment "
+                      "(journal/wal.py); data=(seq, payload_bytes)",
+    "journal_rotate": "WAL segment rotated at the size threshold "
+                      "(journal/wal.py); data=(new_segment_index,)",
+    "journal_snapshot": "snapshot compaction folded + retired segments "
+                        "(journal/wal.py); data=(records_in, records_out, "
+                        "segments_retired)",
+    "journal_replay_begin": "crash-restart journal replay started "
+                            "(journal/replay.py); data=(records,)",
+    "journal_replay_end": "crash-restart journal replay finished "
+                          "(journal/replay.py); data=(records, txns)",
 }
 
 

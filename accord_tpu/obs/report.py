@@ -53,6 +53,17 @@ def _hists_by_label(metrics: dict, name: str, label: str) -> Dict[str, dict]:
     return out
 
 
+def _merged_hist(metrics: dict, name: str) -> dict:
+    """One histogram family merged across every label set."""
+    out = {"count": 0, "sum": 0, "buckets": {}}
+    for h in metrics.get("histograms", {}).get(name, {}).values():
+        out["count"] += h.get("count", 0)
+        out["sum"] += h.get("sum", 0)
+        for e, n in h.get("buckets", {}).items():
+            out["buckets"][e] = out["buckets"].get(e, 0) + n
+    return out
+
+
 def _hist_report(h: dict) -> dict:
     count = h.get("count", 0)
     return {"count": count,
@@ -109,4 +120,21 @@ def summarize(metrics: dict) -> dict:
                                          "accord_pipeline_batch_size_max"),
         },
         "infer": _counter_by_label(metrics, "accord_infer_total", "kind"),
+        "journal": {
+            "appends": _counter_total(metrics,
+                                      "accord_journal_appends_total"),
+            "append_bytes": _counter_total(
+                metrics, "accord_journal_append_bytes_total"),
+            "fsyncs": _counter_total(metrics, "accord_journal_fsync_total"),
+            "rotations": _counter_total(metrics,
+                                        "accord_journal_rotations_total"),
+            "snapshots": _counter_total(metrics,
+                                        "accord_journal_snapshots_total"),
+            "group_commit_batch": _hist_report(_merged_hist(
+                metrics, "accord_journal_group_commit_batch")),
+            "replay_records": _counter_total(
+                metrics, "accord_journal_replay_records_total"),
+            "replay_us": _hist_report(_merged_hist(
+                metrics, "accord_journal_replay_duration_us")),
+        },
     }

@@ -38,6 +38,9 @@ class BurnStats:
         self.shed = 0
         self.lost = 0
         self.pending = 0
+        # crash-restart nemesis: nodes killed (process death) and brought
+        # back from their on-disk journal mid-run
+        self.restarts = 0
         # submit->ack VIRTUAL latency per acked op (us): the measurement for
         # SURVEY §7's flush-window-latency hard part — the batched device
         # store must not inflate the fast path's single-round-trip advantage
@@ -54,7 +57,8 @@ class BurnStats:
 
     def __repr__(self):
         return (f"acks={self.acks} nacks={self.nacks} shed={self.shed} "
-                f"lost={self.lost} pending={self.pending}")
+                f"lost={self.lost} pending={self.pending}"
+                + (f" restarts={self.restarts}" if self.restarts else ""))
 
 
 class BurnRun:
@@ -73,7 +77,10 @@ class BurnRun:
                  clock_drift: bool = False,
                  trace: bool = False,
                  pipeline: bool = False,
-                 pipeline_config=None):
+                 pipeline_config=None,
+                 restarts: int = 0,
+                 journal_dir: Optional[str] = None,
+                 restart_down_s: float = 2.0):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -83,11 +90,22 @@ class BurnRun:
         self.seed = seed
         self.ops = ops
         self.rng = RandomSource(seed)
+        # crash-restart nemesis needs a REAL journal to restart from: a
+        # killed node's in-memory state is discarded wholesale (process
+        # death), so the cluster journal becomes per-node on-disk WALs
+        # (accord_tpu/journal/) instead of the in-memory message list
+        self.restarts = restarts
+        self.restart_down_s = restart_down_s
+        if restarts > 0 and journal_dir is None:
+            import tempfile
+            journal_dir = tempfile.mkdtemp(prefix="accord-burn-wal-")
+        self.journal_dir = journal_dir
         self.cluster = SimCluster(
             n_nodes=nodes, seed=self.rng.next_long(), n_shards=n_shards,
             rf=rf, progress_log_factory=progress_log_factory,
             num_command_stores=num_command_stores,
             store_factory=store_factory, clock_drift=clock_drift,
+            journal_dir=journal_dir,
             trace=trace, pipeline=pipeline,
             pipeline_config=pipeline_config)
         if drop_prob > 0:
@@ -138,6 +156,12 @@ class BurnRun:
         self.stats = BurnStats()
         self.next_value = 0
         self._value_owner: Dict[int, dict] = {}
+        # crash-restart nemesis schedule: kill #i fires once the completed-
+        # op count crosses its threshold (mid-run by construction), restart
+        # follows restart_down_s of virtual downtime later
+        self._kill_at = [self.ops * (i + 1) // (restarts + 1)
+                         for i in range(restarts)]
+        self.restarted_nodes: List[int] = []
 
     # ---------------------------------------------------------- workload --
     def _gen_txn(self) -> Txn:
@@ -174,6 +198,35 @@ class BurnRun:
             update=ListUpdate({Key(t): v for t, v in appends.items()})
             if appends else None)
 
+    # -------------------------------------------------- crash-restart -----
+    def _maybe_kill(self) -> None:
+        """Fire the next scheduled kill once enough ops completed.  The
+        kill itself runs as its own queue event (not inside a client
+        callback's stack), the restart after `restart_down_s` of virtual
+        downtime.  Kills never overlap: a due threshold waits while a
+        previous victim is still down."""
+        if not self._kill_at or self.cluster.dead:
+            return
+        done_ops = (self.stats.acks + self.stats.nacks + self.stats.shed
+                    + self.stats.lost)
+        if done_ops < self._kill_at[0]:
+            return
+        self._kill_at.pop(0)
+        victim = self.rng.pick(self.cluster.live_node_ids())
+        down_us = int(self.restart_down_s * 1e6)
+        queue = self.cluster.queue
+
+        def do_restart():
+            self.cluster.restart_node(victim)
+            self.stats.restarts += 1
+            self.restarted_nodes.append(victim)
+
+        def do_kill():
+            self.cluster.kill_node(victim)
+            queue.add(down_us, do_restart)
+
+        queue.add(0, do_kill)
+
     # --------------------------------------------------------------- run --
     def run(self) -> BurnStats:
         cluster = self.cluster
@@ -188,7 +241,8 @@ class BurnRun:
             idx = submitted[0]
             inflight[0] += 1
             txn = self._gen_txn()
-            origin = self.rng.pick(sorted(cluster.nodes))
+            # clients only reach live nodes (a killed node's socket is gone)
+            origin = self.rng.pick(cluster.live_node_ids())
             start_us = cluster.queue.clock.now_us
             result = cluster.pipeline_submit(origin, txn)
 
@@ -223,6 +277,7 @@ class BurnRun:
                         start_us, end_us))
                 else:
                     self.stats.lost += 1
+                self._maybe_kill()
                 # pipeline: keep `concurrency` txns in flight
                 submit_one()
 
@@ -244,6 +299,15 @@ class BurnRun:
             self.nemesis.stop()
         if self.partition_nemesis is not None:
             self.partition_nemesis.stop()
+        if self.restarts:
+            # a node may still be down (kill near the end of the run):
+            # process virtual time until its scheduled restart lands —
+            # verification requires every replica present
+            cluster.process_until(lambda: not cluster.dead,
+                                  max_items=5_000_000)
+            assert not cluster.dead, "killed node never restarted"
+            assert self.stats.restarts == self.restarts, \
+                (self.stats.restarts, self.restarts)
         # drain trailing replication, then — because acked work may still be
         # repairing (Apply loss after long partitions; the progress-log
         # chase heals it but needs virtual time) — keep draining while
@@ -409,6 +473,18 @@ def main(argv=None) -> int:
     parser.add_argument("--drop", type=float, default=0.0)
     parser.add_argument("--partitions", action="store_true",
                         help="schedule network partitions + heals")
+    parser.add_argument("--restart", type=int, nargs="?", const=1, default=0,
+                        metavar="N",
+                        help="crash-restart nemesis: kill N random nodes "
+                             "mid-burn (process-death semantics) and "
+                             "restart each from its on-disk write-ahead "
+                             "journal (accord_tpu/journal/)")
+    parser.add_argument("--journal", default=None, metavar="DIR",
+                        help="--restart: journal base directory (default: "
+                             "a fresh temp dir)")
+    parser.add_argument("--down", type=float, default=2.0,
+                        help="--restart: virtual seconds a killed node "
+                             "stays down before restarting")
     parser.add_argument("--drift", action="store_true",
                         help="per-node drifting wall clocks")
     parser.add_argument("--stores", type=int, default=1,
@@ -493,13 +569,19 @@ def main(argv=None) -> int:
     for i in range(args.loops):
         seed = args.seed + i
         store_factory = make_store_factory(seed)
+        # one journal world per seed: reusing a directory across loops
+        # would replay seed N's history into seed N+1's cluster
+        journal_dir = (None if args.journal is None
+                       else f"{args.journal}/seed-{seed}")
         run = BurnRun(seed, args.ops, nodes=args.nodes, keys=args.keys,
                       rf=args.rf, range_every=3 if args.range_heavy else 8,
                       n_shards=args.shards, drop_prob=args.drop,
                       store_factory=store_factory,
                       num_command_stores=args.stores,
                       partitions=args.partitions, clock_drift=args.drift,
-                      trace=args.trace, pipeline=args.pipeline)
+                      trace=args.trace, pipeline=args.pipeline,
+                      restarts=args.restart, journal_dir=journal_dir,
+                      restart_down_s=args.down)
         stats = run.run()
         if args.trace:
             for node in run.cluster.nodes.values():

@@ -29,10 +29,15 @@ class SimAgent(Agent):
         self.cluster = cluster
         self.node_id = node_id
         self.failures: List[BaseException] = []
+        # flipped by SimCluster.kill_node: a ghost's timers (progress-log
+        # polls, watchdogs armed pre-crash) keep firing on the discarded
+        # object graph — their failures must not abort the simulation
+        self.dead = False
 
     def on_uncaught_exception(self, failure: BaseException) -> None:
         self.failures.append(failure)
-        self.cluster.queue.fail(failure)
+        if not self.dead:
+            self.cluster.queue.fail(failure)
 
     def on_handled_exception(self, failure: BaseException) -> None:
         # recorded (so harnesses can assert on incidents like a mid-run
@@ -73,6 +78,24 @@ class DriftingClock:
         return max(0, self.clock.now_us + self.offset)
 
 
+class _DeadSink:
+    """Message sink of a killed node's ghost: timers scheduled before the
+    kill still fire on the discarded object graph, and whatever they try to
+    send must vanish (the process is gone)."""
+
+    def send(self, to, request) -> None:
+        pass
+
+    def send_with_callback(self, to, request, callback, executor=None) -> None:
+        pass  # no reply ever: the caller's RPC timeout fires
+
+    def reply(self, to, reply_context, reply) -> None:
+        pass
+
+    def deliver_reply(self, msg_id, from_id, reply) -> None:
+        pass
+
+
 class SimCluster:
     """N simulated nodes over a token-range topology."""
 
@@ -81,69 +104,112 @@ class SimCluster:
                  progress_log_factory: Optional[Callable] = None,
                  store_factory: Optional[Callable] = None,
                  clock_drift: bool = False, journal: bool = True,
+                 journal_dir: Optional[str] = None,
                  trace: bool = False, pipeline: bool = False,
                  pipeline_config=None):
         self.random = RandomSource(seed)
         self.queue = PendingQueue(self.random.fork())
         self.network = SimNetwork(self.queue, self.random.fork())
         self.scheduler = SimScheduler(self.queue)
-        from accord_tpu.sim.journal import Journal
-        self.journal = Journal() if journal else None
+        # journal_dir turns the in-memory message journal into the REAL
+        # write-ahead log (accord_tpu/journal/): per-node on-disk segments
+        # in synchronous (deterministic) mode, enabling the crash-restart
+        # nemesis — kill_node discards all in-memory state, restart_node
+        # rebuilds the replica from its journal directory
+        self.journal_dir = journal_dir
+        if journal_dir is not None:
+            from accord_tpu.journal.wal import DurableJournalSet
+            self.journal = DurableJournalSet(journal_dir)
+        elif journal:
+            from accord_tpu.sim.journal import Journal
+            self.journal = Journal()
+        else:
+            self.journal = None
         self.token_span = token_span
         self.nodes: Dict[int, Node] = {}
         self.agents: Dict[int, SimAgent] = {}
+        self.dead: set = set()
+        self.restarts = 0
         rf = rf if rf is not None else n_nodes
         node_ids = list(range(1, n_nodes + 1))
         self.topology = self._make_topology(1, node_ids, n_shards, rf)
         # epoch ledger backing each node's ConfigurationService fetches
         self.topology_ledger: Dict[int, Topology] = {1: self.topology}
         self.config_services: Dict[int, object] = {}
+        # per-node build args retained so restart_node can rebuild an
+        # identically configured replica
+        self._num_command_stores = num_command_stores
+        self._progress_log_factory = progress_log_factory
+        self._store_factory = store_factory
+        self._clock_drift = clock_drift
+        self._trace_enabled = trace
+        # set by start_durability_scheduling; restart_node reuses them
+        self._durability_cycle_s = None
+        self._durability_global_every = None
         for nid in node_ids:
-            agent = SimAgent(self, nid)
-            sink = NodeSink(nid, self.network)
-            now_us = (DriftingClock(self.queue.clock, self.random.fork()).now_us
-                      if clock_drift
-                      else (lambda: self.queue.clock.now_us))
-            from accord_tpu.obs import NodeObs
-            from accord_tpu.utils.tracing import Trace
-            node = Node(
-                nid, sink, agent, self.scheduler, ListStore(nid),
-                self.random.fork(), num_shards=num_command_stores,
-                progress_log_factory=progress_log_factory,
-                store_factory=store_factory,
-                now_us=now_us,
-                trace=Trace(nid, enabled=True,
-                            clock=lambda: self.queue.clock.now_us / 1e6)
-                if trace else None,
-                # span timestamps come from the UNDRIFTED virtual clock:
-                # DriftingClock.now_us steps a random walk per call, so
-                # clocking obs events through it would perturb the very
-                # protocol behavior being observed (and mis-order stitched
-                # cross-node traces)
-                obs=NodeObs(nid,
-                            clock_us=lambda: self.queue.clock.now_us),
-            )
-            node.journal = self.journal
-            self.agents[nid] = agent
-            self.nodes[nid] = node
-            self.network.register(node)
-            # topology flows through the node's ConfigurationService
-            # (reference AbstractConfigurationService): the node is a
-            # listener, the cluster ledger serves gap fetches
-            service = DirectConfigService(nid, self.topology_ledger.get)
-            service.attach_node(node)
-            self.config_services[nid] = service
-            service.report_topology(self.topology)
+            self._build_node(nid)
         # continuous micro-batching ingest (accord_tpu/pipeline/) on every
         # node, deadline-driven by the shared virtual-time scheduler so the
         # deterministic burn can exercise admission batching, MultiPreAccept
         # envelopes and load shedding under the full nemesis stack
         self.pipelines: Dict[int, object] = {}
+        self._pipeline_enabled = pipeline
+        self._pipeline_config = pipeline_config
         if pipeline:
             from accord_tpu.pipeline import Pipeline
             for nid, node in self.nodes.items():
                 self.pipelines[nid] = Pipeline(node, self.scheduler,
                                                pipeline_config)
+
+    def _build_node(self, nid: int) -> Node:
+        """Construct (or reconstruct) one node and wire it to the cluster:
+        network registration, config service, journal attachment."""
+        agent = SimAgent(self, nid)
+        sink = NodeSink(nid, self.network)
+        now_us = (DriftingClock(self.queue.clock, self.random.fork()).now_us
+                  if self._clock_drift
+                  else (lambda: self.queue.clock.now_us))
+        from accord_tpu.obs import NodeObs
+        from accord_tpu.utils.tracing import Trace
+        node = Node(
+            nid, sink, agent, self.scheduler, ListStore(nid),
+            self.random.fork(), num_shards=self._num_command_stores,
+            progress_log_factory=self._progress_log_factory,
+            store_factory=self._store_factory,
+            now_us=now_us,
+            trace=Trace(nid, enabled=True,
+                        clock=lambda: self.queue.clock.now_us / 1e6)
+            if self._trace_enabled else None,
+            # span timestamps come from the UNDRIFTED virtual clock:
+            # DriftingClock.now_us steps a random walk per call, so
+            # clocking obs events through it would perturb the very
+            # protocol behavior being observed (and mis-order stitched
+            # cross-node traces)
+            obs=NodeObs(nid, clock_us=lambda: self.queue.clock.now_us),
+        )
+        if self.journal_dir is not None:
+            self.journal.open_node(nid, registry=node.obs.registry,
+                                   flight=node.obs.flight)
+        node.journal = self.journal
+        self.agents[nid] = agent
+        self.nodes[nid] = node
+        self.network.register(node)
+        # topology flows through the node's ConfigurationService
+        # (reference AbstractConfigurationService): the node is a
+        # listener, the cluster ledger serves gap fetches
+        service = DirectConfigService(nid, self.topology_ledger.get)
+        service.attach_node(node)
+        self.config_services[nid] = service
+        if nid in self.dead:
+            # restart: feed the full epoch history (replayed messages gate
+            # on their txn's epoch) WITHOUT peer bootstraps — the journal
+            # replay that follows is this node's data source
+            for epoch in sorted(self.topology_ledger):
+                service.report_topology(self.topology_ledger[epoch],
+                                        start_sync=False)
+        else:
+            service.report_topology(self.topology)
+        return node
 
     def pipeline_submit(self, node_id: int, txn):
         """Client entry through the node's ingest pipeline (falls back to
@@ -175,10 +241,72 @@ class SimCluster:
         (CoordinateDurabilityScheduling.java; burn Cluster.java:333-349)."""
         from accord_tpu.coordinate.durability import \
             CoordinateDurabilityScheduling
+        # remembered so a restarted node rejoins the durability rotation
+        self._durability_cycle_s = shard_cycle_s
+        self._durability_global_every = global_cycle_every
         for node in self.nodes.values():
             CoordinateDurabilityScheduling(
                 node, shard_cycle_s=shard_cycle_s,
                 global_cycle_every=global_cycle_every).start()
+
+    # --------------------------------------------------- crash-restart nemesis --
+    def live_node_ids(self) -> List[int]:
+        return sorted(set(self.nodes) - self.dead)
+
+    def kill_node(self, node_id: int) -> None:
+        """Process-death semantics: every piece of in-memory state —
+        command stores, data store, obs rings, pending callbacks — is
+        discarded; only the on-disk journal survives.  Requires a durable
+        journal (journal_dir), or there would be nothing to restart from.
+
+        The dead Node object is not (cannot be) garbage-collected
+        immediately: virtual-time timers scheduled before the kill still
+        hold it.  Those ghosts are neutralized, not cancelled — their sink
+        drops everything and their agent no longer fails the queue — which
+        is exactly a killed process's externally observable behavior."""
+        assert self.journal_dir is not None, \
+            "kill_node without a durable journal loses acked state"
+        assert node_id not in self.dead
+        node = self.nodes[node_id]
+        self.dead.add(node_id)
+        # deliveries to the dead id vanish (SimNetwork checks registration)
+        self.network.nodes.pop(node_id, None)
+        node.sink = _DeadSink()
+        node.journal = None  # a dead process journals nothing
+        self.agents[node_id].dead = True
+        self.pipelines.pop(node_id, None)
+        # close the WAL file handles; un-synced OS buffers survive a
+        # process kill, so nothing acked is lost (sync mode anyway)
+        self.journal.close_node(node_id)
+
+    def restart_node(self, node_id: int) -> "Node":
+        """Bring a killed node back from its journal directory: build a
+        fresh replica of the same identity, feed it every ledger epoch
+        (start_sync=False — its state comes from the journal, not a peer
+        bootstrap), replay the journal through normal message processing,
+        and re-register it with the network.  Anything it missed while
+        down heals exactly like a partition: later txns' deps name the
+        missed ones and the progress log chases them."""
+        assert node_id in self.dead, f"node {node_id} is not dead"
+        node = self._build_node(node_id)
+        self.dead.discard(node_id)
+        self.restarts += 1
+        wal = self.journal.wals[node_id]
+        records = wal.load_records()
+        from accord_tpu.journal.replay import replay_node
+        replay_node(node, records, registry=node.obs.registry,
+                    flight=node.obs.flight)
+        if self._durability_cycle_s is not None:
+            from accord_tpu.coordinate.durability import \
+                CoordinateDurabilityScheduling
+            CoordinateDurabilityScheduling(
+                node, shard_cycle_s=self._durability_cycle_s,
+                global_cycle_every=self._durability_global_every).start()
+        if self._pipeline_enabled:
+            from accord_tpu.pipeline import Pipeline
+            self.pipelines[node_id] = Pipeline(node, self.scheduler,
+                                               self._pipeline_config)
+        return node
 
     # ----------------------------------------------------------- execution --
     def process_all(self, max_items: int = 1_000_000) -> int:

@@ -937,6 +937,78 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16,
     emit(result)
 
 
+def bench_journal(n_append=20000, inflight=256, fsync_window_us=2000,
+                  sync_ops=640):
+    """Satellite of the durable-WAL tentpole (accord_tpu/journal/): group
+    commit vs fsync-per-append at EQUAL durability.  Both lanes run the
+    host's actual ack discipline — append, then release the ack from an
+    `on_durable` callback once the COVERING FSYNC has landed (what
+    DurableAckSink does to replies; no thread blocks per txn) — with a
+    bounded in-flight window like a loaded node's dispatch loop.  The only
+    difference between the lanes is the fsync policy: a deadline/batch/
+    idle-bounded group-commit window (one fsync covers a window's worth of
+    appends) vs the synchronous mode's fsync per append.  The emitted
+    ratio is therefore exactly the cost of NOT batching durability."""
+    import tempfile
+    import threading
+
+    from accord_tpu.journal.wal import JournalConfig, WriteAheadLog
+    from accord_tpu.obs.report import summarize
+
+    def sample_request():
+        # a real journaled verb with small fixed encode cost (~220 bytes,
+        # ~14us): both lanes pay encoding identically, so a bulky payload
+        # would only dilute the fsync-discipline difference this lane
+        # exists to measure (encode throughput has its own lanes)
+        from accord_tpu.messages.commit import CommitInvalidate
+        from accord_tpu.primitives.keys import Route, RoutingKey, RoutingKeys
+        from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+        tid = TxnId.create(1, 12345, TxnKind.WRITE, Domain.KEY, 1)
+        return CommitInvalidate(
+            tid, Route.of_keys(RoutingKey(11), RoutingKeys.of(11, 42)))
+
+    msg = sample_request()
+
+    def run_mode(window_us: int, total: int) -> tuple:
+        d = tempfile.mkdtemp(prefix="bench-wal-")
+        cfg = JournalConfig(d, fsync_window_us=window_us,
+                            segment_bytes=64 << 20, snapshot_segments=0)
+        wal = WriteAheadLog(d, config=cfg, retain=False)
+        window = threading.BoundedSemaphore(inflight)
+        acked = threading.Semaphore(0)
+        t0 = time.perf_counter()
+        for _ in range(total):
+            window.acquire()
+            seq = wal.append(msg)
+            wal.on_durable(seq, lambda: (window.release(),
+                                         acked.release()))
+        for _ in range(total):  # every ack observed before the clock stops
+            acked.acquire()
+        dt = max(time.perf_counter() - t0, 1e-9)
+        assert wal.durable_seq >= total
+        snap = wal.registry.snapshot()
+        wal.close()
+        return total / dt, snap
+
+    group_tps, group_snap = run_mode(fsync_window_us, n_append)
+    sync_tps, _sync_snap = run_mode(0, sync_ops)
+    journal_obs = summarize(group_snap)["journal"]
+    emit({
+        "metric": "journal_group_commit_append_per_sec",
+        "value": round(group_tps, 1),
+        "unit": "append/s",
+        "workload": f"durable-acked (on_durable callbacks, {inflight} "
+                    f"in flight) wire-encoded requests",
+        "appends": n_append,
+        "fsync_window_us": fsync_window_us,
+        "fsync_per_append_per_sec": round(sync_tps, 1),
+        "group_vs_fsync_ratio": round(group_tps / max(sync_tps, 1e-9), 1),
+        "fsyncs_group": journal_obs["fsyncs"],
+        "batch_mean": journal_obs["group_commit_batch"]["mean"],
+        "obs": {"journal": journal_obs},
+    })
+
+
 def bench_pipeline(nodes=3, keys=100, n_ops=400, seed=7):
     """Satellite of the ingest-pipeline tentpole: the SAME tcp workload and
     differenced wall-clock discipline, with ACCORD_PIPELINE=1 in every node
@@ -1432,7 +1504,7 @@ def main():
     ap.add_argument("--config", default="default",
                     choices=["default", "zipf1m", "rangestress", "tpcc",
                              "maelstrom", "maelstrom-rw", "tcp",
-                             "pipeline", "scalar"])
+                             "pipeline", "scalar", "journal"])
     ap.add_argument("--guard", action="store_true",
                     help="after the run, diff the row (headline + per-"
                          "kernel profile p50s) against the last clean "
@@ -1473,7 +1545,7 @@ def main():
     if ns.dry_run:
         raise SystemExit(run_guard_dry(CONFIG))
     if ns.config not in ("maelstrom", "maelstrom-rw", "tcp", "pipeline",
-                         "scalar"):
+                         "scalar", "journal"):
         # device-using configs probe the (possibly dead-tunneled) backend
         # first; host-only configs never touch the chip
         from accord_tpu.utils.backend import resolve_platform
@@ -1494,6 +1566,8 @@ def main():
         bench_pipeline(nodes=3, keys=100)
     elif ns.config == "scalar":
         bench_scalar()
+    elif ns.config == "journal":
+        bench_journal()
     else:
         bench_rangestress()
     if ns.guard:

@@ -150,3 +150,18 @@ def test_every_flight_event_kind_is_documented():
     # emitting layer
     for kind, desc in EVENT_KINDS.items():
         assert len(desc) > 20 and "/" in desc, (kind, desc)
+
+
+def test_journal_lifecycle_kinds_are_covered():
+    """The durable WAL's full lifecycle must stay on the forensics ring:
+    append, segment rotation, snapshot compaction, and both replay edges.
+    (The generic documented<->recorded lint above would catch a missing
+    pair; this pins the SET, so deleting a journal hook plus its docs row
+    together still fails.)"""
+    recorded = _recorded_flight_kinds()
+    for kind in ("journal_append", "journal_rotate", "journal_snapshot",
+                 "journal_replay_begin", "journal_replay_end"):
+        assert kind in EVENT_KINDS, f"{kind} missing from EVENT_KINDS"
+        assert kind in recorded, f"nothing records {kind}"
+        assert any(p.startswith("journal") for p in recorded[kind]), \
+            (kind, recorded[kind])

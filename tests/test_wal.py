@@ -1,0 +1,363 @@
+"""Durable write-ahead journal (accord_tpu/journal/): segments, group
+commit, snapshot compaction, crash-restart replay — unit level and end to
+end through the burn's crash-restart nemesis (`BurnRun --restart`), which
+must pass every checker (verify + Elle + journal reconstruction) with a
+node killed mid-run and rebuilt from its on-disk journal.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from accord_tpu.journal.segment import (SegmentWriter, list_segments,
+                                        read_segment, scan_segment)
+from accord_tpu.journal.snapshot import (canonical_encoding, fold_messages,
+                                         read_snapshot)
+from accord_tpu.journal.wal import (DurableAckSink, JournalConfig,
+                                    WriteAheadLog)
+
+
+def _sample_msg(i: int = 0):
+    from accord_tpu.messages.commit import CommitInvalidate
+    from accord_tpu.primitives.keys import Route, RoutingKey, RoutingKeys
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    tid = TxnId.create(1, 1000 + i, TxnKind.WRITE, Domain.KEY, 1 + i % 3)
+    return CommitInvalidate(
+        tid, Route.of_keys(RoutingKey(5), RoutingKeys.of(5, 7)))
+
+
+# ------------------------------------------------------------- segments ----
+
+class TestSegments:
+    def test_frame_round_trip(self, tmp_path):
+        p = str(tmp_path / "s.wal")
+        w = SegmentWriter(p)
+        payloads = [b"alpha", b"b" * 1000, b""]
+        for pl in payloads:
+            w.append(pl)
+        w.close()
+        assert read_segment(p) == payloads
+
+    @pytest.mark.parametrize("garbage", [
+        b"\x00", b"\x00\x00\x00\x05ab",                 # torn payload
+        b"\x00\x00\x00\x03" + b"\x00\x00\x00\x00" + b"abc",  # bad CRC
+        b"\xff\xff\xff\xff\x00\x00\x00\x00" + b"x" * 64,     # absurd length
+    ])
+    def test_torn_tail_truncated(self, tmp_path, garbage):
+        p = str(tmp_path / "s.wal")
+        w = SegmentWriter(p)
+        w.append(b"keep-me")
+        w.append(b"me-too")
+        w.close()
+        good_size = os.path.getsize(p)
+        with open(p, "ab") as f:
+            f.write(garbage)
+        records, good, torn = scan_segment(p)
+        assert torn and good == good_size
+        assert read_segment(p, truncate=True) == [b"keep-me", b"me-too"]
+        assert os.path.getsize(p) == good_size  # repaired on disk
+        # appending after repair splices onto the last whole record
+        w2 = SegmentWriter(p)
+        w2.append(b"three")
+        w2.close()
+        assert read_segment(p) == [b"keep-me", b"me-too", b"three"]
+
+
+# ------------------------------------------------------------------ WAL ----
+
+class TestWal:
+    def test_sync_mode_durable_inline_and_reload(self, tmp_path):
+        cfg = JournalConfig(str(tmp_path), fsync_window_us=0)
+        wal = WriteAheadLog(str(tmp_path), config=cfg)
+        for i in range(20):
+            seq = wal.append(_sample_msg(i))
+            assert wal.durable_seq == seq  # fsync-per-append: durable now
+        wal.close()
+        wal2 = WriteAheadLog(str(tmp_path), config=cfg)
+        records = wal2.load_records()
+        assert len(records) == 20
+        assert {type(r).__name__ for r in records} == {"CommitInvalidate"}
+        wal2.close()
+
+    def test_rotation_and_snapshot_compaction(self, tmp_path):
+        cfg = JournalConfig(str(tmp_path), fsync_window_us=0,
+                            segment_bytes=2048, snapshot_segments=3)
+        wal = WriteAheadLog(str(tmp_path), config=cfg)
+        msgs = [_sample_msg(i) for i in range(6)]
+        for _ in range(40):  # heavy retransmission: compaction's bread
+            for m in msgs:
+                wal.append(m)
+        snap = wal.registry.snapshot()
+        assert snap["counters"]["accord_journal_rotations_total"][""] > 0
+        assert snap["counters"]["accord_journal_snapshots_total"][""] > 0
+        assert os.path.exists(str(tmp_path / "snapshot.snap"))
+        wal.close()
+        # reload yields exactly the distinct knowledge: reconstruction of
+        # the folded journal equals reconstruction of the full history
+        from accord_tpu.sim.journal import reconstruct
+        wal2 = WriteAheadLog(str(tmp_path), config=cfg)
+        reloaded = wal2.load_records()
+        assert len(reloaded) < 240  # actually compacted
+        want = reconstruct(msgs * 40)
+        got = reconstruct(reloaded)
+        assert set(want) == set(got)
+        for tid, r in want.items():
+            g = got[tid]
+            assert (r.invalidated, r.witnessed) == (g.invalidated, g.witnessed)
+        wal2.close()
+
+    def test_snapshot_covers_survive_crash_between_rename_and_unlink(
+            self, tmp_path):
+        cfg = JournalConfig(str(tmp_path), fsync_window_us=0,
+                            segment_bytes=1024, snapshot_segments=2)
+        wal = WriteAheadLog(str(tmp_path), config=cfg)
+        for i in range(60):
+            wal.append(_sample_msg(i % 5))
+        wal.close()
+        covers, _msgs = read_snapshot(str(tmp_path / "snapshot.snap"))
+        # simulate the crash window: a covered segment reappears
+        stale = str(tmp_path / f"segment-{covers:08d}.wal")
+        with open(stale, "wb") as f:
+            f.write(b"")
+        wal2 = WriteAheadLog(str(tmp_path), config=cfg)
+        wal2.load_records()
+        assert not os.path.exists(stale)  # dropped, not double-replayed
+        wal2.close()
+
+    def test_group_commit_coalesces_fsyncs(self, tmp_path):
+        cfg = JournalConfig(str(tmp_path), fsync_window_us=5000,
+                            segment_bytes=1 << 20)
+        wal = WriteAheadLog(str(tmp_path), config=cfg)
+        n, workers = 30, 6
+
+        def worker():
+            for i in range(n):
+                seq = wal.append(_sample_msg(i))
+                assert wal.wait_durable(seq, 20.0)
+
+        threads = [threading.Thread(target=worker) for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = wal.registry.snapshot()
+        appends = snap["counters"]["accord_journal_appends_total"][""]
+        fsyncs = snap["counters"]["accord_journal_fsync_total"][""]
+        assert appends == n * workers
+        assert fsyncs < appends, "group commit never batched"
+        hist = snap["histograms"]["accord_journal_group_commit_batch"][""]
+        assert hist["count"] == fsyncs
+        wal.close()
+        # reload sees every durable-acked record
+        wal2 = WriteAheadLog(str(tmp_path), config=cfg)
+        assert len(wal2.load_records()) == n * workers
+        wal2.close()
+
+    def test_durable_ack_sink_gates_replies_on_fsync(self, tmp_path):
+        class Sink:
+            def __init__(self):
+                self.replies = []
+
+            def reply(self, to, ctx, reply):
+                self.replies.append((to, ctx, reply))
+
+        cfg = JournalConfig(str(tmp_path), fsync_window_us=200_000)
+        wal = WriteAheadLog(str(tmp_path), config=cfg)
+        inner = Sink()
+        gated = DurableAckSink(inner, wal)
+        gated.reply(2, "ctx0", "pre-append-ok")  # nothing pending: immediate
+        assert inner.replies == [(2, "ctx0", "pre-append-ok")]
+        seq = wal.append(_sample_msg())
+        gated.reply(3, "ctx1", "ack")
+        assert len(inner.replies) == 1, "ack leaked before fsync"
+        assert wal.sync()  # force the window closed
+        assert wal.durable_seq >= seq
+        deadline = threading.Event()
+        for _ in range(100):
+            if len(inner.replies) == 2:
+                break
+            deadline.wait(0.02)
+        assert inner.replies[1] == (3, "ctx1", "ack")
+        wal.close()
+
+
+# ------------------------------------------------------------- the fold ----
+
+def test_fold_is_lossless_under_reconstruction():
+    """Compaction's fold over a real hostile burn's journals: per txn, the
+    validator's reconstruction of the folded set must equal that of the
+    raw history (the guarantee that compaction can never weaken replay)."""
+    from accord_tpu.sim.burn import BurnRun
+    from accord_tpu.sim.journal import reconstruct
+
+    run = BurnRun(7, 60, drop_prob=0.1)
+    run.run()
+    folded_total = raw_total = 0
+    for nid in run.cluster.nodes:
+        records = run.cluster.journal.for_node(nid)
+        folded = fold_messages(records)
+        raw_total += len(records)
+        folded_total += len(folded)
+        want, got = reconstruct(records), reconstruct(folded)
+        assert set(want) == set(got)
+        for tid, r in want.items():
+            g = got[tid]
+            assert r.definition_keys == g.definition_keys, tid
+            assert r.execute_ats == g.execute_ats, tid
+            assert r.stable_dep_ids == g.stable_dep_ids, tid
+            assert r.write_keys == g.write_keys, tid
+            assert (r.accept_evidence, r.has_outcome, r.invalidated) \
+                == (g.accept_evidence, g.has_outcome, g.invalidated), tid
+    assert folded_total <= raw_total
+
+
+# --------------------------------------------------- crash-restart burns ----
+
+def test_burn_restart_smoke(tmp_path):
+    """Tier-1 acceptance: a burn with one mid-run kill + journal restart
+    passes all checkers (verify + Elle + journal reconstruction run inside
+    BurnRun.run) with the restarted node participating."""
+    from accord_tpu.sim.burn import BurnRun
+
+    run = BurnRun(11, 80, restarts=1, journal_dir=str(tmp_path))
+    stats = run.run()
+    assert stats.restarts == 1
+    assert run.restarted_nodes and run.restarted_nodes[0] in run.cluster.nodes
+    assert stats.acks > 0
+    assert run.journal_checked > 0, "journal validation checked nothing"
+    # journal obs: appends + replay surfaced in the merged burn metrics
+    summary = run.metrics_snapshot()["summary"]["journal"]
+    assert summary["appends"] > 0
+    assert summary["replay_records"] > 0
+    assert summary["replay_us"]["count"] == 1
+    # forensics: the restarted node's ring leads with the replay edges
+    restarted = run.cluster.nodes[run.restarted_nodes[0]]
+    kinds = [e[2] for e in restarted.obs.flight.events]
+    assert "journal_replay_begin" in kinds
+    assert "journal_replay_end" in kinds
+    assert "journal_append" in kinds
+
+
+def test_burn_restart_hostile(tmp_path):
+    """Crash-restart composed with message loss: the restarted node must
+    heal what it missed while down exactly like a partitioned replica."""
+    from accord_tpu.sim.burn import BurnRun
+
+    run = BurnRun(23, 90, drop_prob=0.05, restarts=1,
+                  journal_dir=str(tmp_path))
+    stats = run.run()
+    assert stats.restarts == 1
+    assert stats.acks > 0
+    assert run.journal_checked > 0
+
+
+def test_kill_without_journal_refuses(tmp_path):
+    from accord_tpu.sim.cluster import SimCluster
+
+    cluster = SimCluster(n_nodes=3, seed=1)
+    with pytest.raises(AssertionError, match="durable journal"):
+        cluster.kill_node(1)
+
+
+def test_restarted_node_reissues_monotonic_txn_ids(tmp_path):
+    """The replay HLC fold: a restarted node's next TxnId must sort above
+    everything in its journal even if its clock regressed (a duplicate
+    TxnId would be two different transactions with one identity)."""
+    from accord_tpu.sim.burn import BurnRun
+
+    run = BurnRun(31, 60, restarts=1, journal_dir=str(tmp_path))
+    run.run()
+    nid = run.restarted_nodes[0]
+    node = run.cluster.nodes[nid]
+    max_hlc = 0
+    for msg in run.cluster.journal.for_node(nid):
+        for ts in (getattr(msg, "txn_id", None),
+                   getattr(msg, "execute_at", None)):
+            if ts is not None:
+                max_hlc = max(max_hlc, ts.hlc)
+    assert node._hlc >= max_hlc
+    from accord_tpu.primitives.timestamp import Domain, TxnKind
+    fresh = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+    assert fresh.hlc > max_hlc
+
+
+@pytest.mark.slow
+def test_maelstrom_blackbox_crash_restart(tmp_path):
+    """The whole story over real OS processes: SIGKILL a node (no shutdown
+    hook), respawn it against the same ACCORD_JOURNAL directory, run more
+    traffic, and verify BOTH phases strict-serializable — acked writes
+    from before the crash must still be there."""
+    from accord_tpu.host.runner import MaelstromRunner
+
+    r = MaelstromRunner(3, seed=5, journal_dir=str(tmp_path))
+    try:
+        r.init_all()
+        s1 = r.run_workload(n_ops=25, n_keys=6)
+        assert s1["acked"] > 20
+        r.pump_until(lambda: not r.pending, 30.0)
+        r.restart_node("n2")
+        # the restarted node replayed its journal (visible in its dir)
+        node_dir = tmp_path / "node-2"
+        assert list_segments(str(node_dir)), "n2 journaled nothing"
+        s2 = r.run_workload(n_ops=25, n_keys=6)
+        assert s2["acked"] > 20
+        checked = r.check_strict_serializability(6)
+        assert checked > 40
+    finally:
+        r.close()
+
+
+# ------------------------------------------------------------- bench lane ---
+
+def test_bench_journal_guard_dry_run():
+    """CI smoke for the journal bench lane: `--config journal --guard
+    --dry-run` parses the checked-in history (schema rot fails fast)."""
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py"), "--config",
+         "journal", "--guard", "--dry-run"],
+        capture_output=True, text=True, timeout=120, cwd=here,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-500:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "journal_guard" and row["dry_run"]
+
+
+def test_bench_journal_lane_group_commit_wins(tmp_path):
+    """The acceptance ratio, scaled down for tier-1: the same durable-ack
+    discipline over group commit vs fsync-per-append.  The bench lane
+    records >=5x on this box; here we assert a conservative >=2x so CI
+    noise cannot flake the suite."""
+    import time as _time
+
+    from accord_tpu.journal.wal import JournalConfig, WriteAheadLog
+
+    msg = _sample_msg()
+
+    def run_mode(window_us, total, subdir):
+        d = str(tmp_path / subdir)
+        cfg = JournalConfig(d, fsync_window_us=window_us,
+                            segment_bytes=64 << 20, snapshot_segments=0)
+        wal = WriteAheadLog(d, config=cfg, retain=False)
+        window = threading.BoundedSemaphore(128)
+        acked = threading.Semaphore(0)
+        t0 = _time.perf_counter()
+        for _ in range(total):
+            window.acquire()
+            seq = wal.append(msg)
+            wal.on_durable(seq, lambda: (window.release(),
+                                         acked.release()))
+        for _ in range(total):
+            acked.acquire()
+        dt = max(_time.perf_counter() - t0, 1e-9)
+        wal.close()
+        return total / dt
+
+    group = run_mode(2000, 2000, "group")
+    sync = run_mode(0, 250, "sync")
+    assert group > 2.0 * sync, (group, sync)
