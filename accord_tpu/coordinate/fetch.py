@@ -26,7 +26,11 @@ from accord_tpu.utils.async_chains import AsyncResult
 
 class _CheckShards(Callback):
     """Quorum of CheckStatus over the route's shards, merged
-    (coordinate/CheckShards.java)."""
+    (coordinate/CheckShards.java).  A second tracker over the same
+    topologies folds the per-reply InvalidIf evidence: when it reaches a
+    quorum in every contacted shard, the merged reply is stamped
+    `quorum_invalid_evidence` — the reference's Infer.inferInvalidWithQuorum
+    precondition, consumed by infer.infer_invalid_with_quorum."""
 
     def __init__(self, node, txn_id: TxnId, route: Route,
                  include_info: IncludeInfo, result: AsyncResult):
@@ -37,17 +41,20 @@ class _CheckShards(Callback):
         self.result = result
         self.merged: Optional[CheckStatusOk] = None
         self.tracker: Optional[QuorumTracker] = None
+        self.evidence_tracker: Optional[QuorumTracker] = None
         self.done = False
-        # Infer-narrowing price counters: which contacted replicas attached
-        # durability-derived invalid-if-undecided evidence
+        # Infer price counters: which contacted replicas attached
+        # durability-derived invalidation evidence
         self._contacted = 0
         self._evidence_replies = 0
+        self._evidence_quorum = False
 
     def start(self) -> None:
         topologies = self.node.topology.with_unsynced_epochs(
             self.route.participants(), self.txn_id.epoch,
             max(self.txn_id.epoch, self.node.epoch))
         self.tracker = QuorumTracker(topologies)
+        self.evidence_tracker = QuorumTracker(topologies)
         for to in topologies.nodes():
             scope = TxnRequest.compute_scope(to, topologies, self.route)
             if scope is None:
@@ -61,8 +68,15 @@ class _CheckShards(Callback):
         if self.done:
             return
         if isinstance(reply, CheckStatusOk):
-            if reply.invalid_if_undecided:
+            from accord_tpu.local.status import InvalidIf
+            if reply.invalid_if >= InvalidIf.IF_UNDECIDED:
                 self._evidence_replies += 1
+                # evidence only ever attaches to an undecided local state
+                # (messages/checkstatus.py), so an evidence quorum is also
+                # an undecided quorum — the inferInvalidWithQuorum input
+                if self.evidence_tracker.record_success(from_id) \
+                        == RequestStatus.SUCCESS:
+                    self._evidence_quorum = True
             self.merged = (reply if self.merged is None
                            else self.merged.merge(reply))
         if self.tracker.record_success(from_id) == RequestStatus.SUCCESS:
@@ -70,11 +84,19 @@ class _CheckShards(Callback):
             if self._evidence_replies:
                 stats = self.node.infer_stats
                 stats["evidence"] += 1
-                # majority-of-contacted proxy for the reference's per-shard
-                # quorum test (Infer.inferInvalidWithQuorum): these are the
-                # interrogations the reference resolves with NO extra round
-                if self._evidence_replies * 2 > self._contacted:
+                if self._evidence_quorum:
+                    # per-shard quorum of evidence (the exact
+                    # Infer.inferInvalidWithQuorum test, replacing the r5
+                    # majority-of-contacted proxy): resolvable with NO
+                    # extra round under the full ladder
                     stats["quorum_evidence"] += 1
+                    obs = getattr(self.node, "obs", None)
+                    if obs is not None:
+                        obs.flight.record(
+                            "infer_evidence", repr(self.txn_id),
+                            (self._evidence_replies, self._contacted))
+            if self.merged is not None:
+                self.merged.quorum_invalid_evidence = self._evidence_quorum
             self.result.try_success(self.merged)
 
     def on_failure(self, from_id: int, failure: BaseException) -> None:
@@ -101,12 +123,22 @@ def check_shards(node, txn_id: TxnId, route: Route,
 def fetch_data(node, txn_id: TxnId, route: Route) -> AsyncResult:
     """Fetch the maximum available knowledge for txn_id from its shards and
     apply it locally; resolves to the merged CheckStatusOk
-    (coordinate/FetchData.java)."""
+    (coordinate/FetchData.java).  When the reply quorum itself proves the
+    txn invalid (per-shard InvalidIf evidence, coordinate/infer.py), the
+    invalidation is committed right here with no further round — the
+    blocked-dependency chase that drove this fetch unblocks on the
+    CommitInvalidate instead of escalating to recovery."""
     result: AsyncResult = AsyncResult()
 
     def on_checked(merged: Optional[CheckStatusOk], failure):
         if failure is not None:
             result.try_failure(failure)
+            return
+        from accord_tpu.coordinate.infer import infer_invalid_with_quorum
+        if merged is not None \
+                and merged.save_status < SaveStatus.PRE_COMMITTED \
+                and infer_invalid_with_quorum(node, txn_id, route, merged):
+            result.try_success(merged)
             return
         if merged is not None and merged.save_status > SaveStatus.NOT_DEFINED:
             full = merged.route if merged.route is not None else route
@@ -251,16 +283,24 @@ def maybe_recover(node, txn_id: TxnId, route: Route,
         undecided = merged is None \
             or merged.save_status < SaveStatus.PRE_COMMITTED
         # durability-derived evidence (coordinate/infer.py): an undecided
-        # txn below the majority-durability bound is headed for
-        # invalidation — go straight to the ballot-backed invalidation
-        # round instead of attempting recovery first (its ballots still
-        # settle any race with a live recovery)
+        # txn below the majority-durability bound is headed for invalidation
+        if undecided:
+            from accord_tpu.coordinate.infer import infer_invalid_with_quorum
+            from accord_tpu.coordinate.errors import Invalidated
+            if infer_invalid_with_quorum(node, txn_id, best, merged):
+                # full ladder: a per-shard quorum of InvalidIf evidence
+                # commits the invalidation with ZERO extra rounds
+                # (Infer.inferInvalidWithQuorum) — no ballot needed, the
+                # fence-refusal rule blocks any competing decision quorum
+                result.try_failure(Invalidated(
+                    f"{txn_id} invalidated by quorum inference"))
+                return
         inferred_invalid = (undecided and merged is not None
                             and merged.invalid_if_undecided)
         if inferred_invalid:
-            # the price of the documented Infer narrowing: a full
-            # ballot-protected Invalidate round where the reference's
-            # inferInvalidWithQuorum may commit-invalidate with none
+            # evidence without a full quorum of it (or the =0 escape
+            # hatch): pay a ballot-protected Invalidate round where the
+            # full ladder may commit-invalidate with none
             node.infer_stats["inferred_rounds"] += 1
         chase = (node.invalidate
                  if undecided and (inferred_invalid or not best.is_full)

@@ -24,7 +24,7 @@ from accord_tpu.messages.commit import CommitKind
 from accord_tpu.messages.getdeps import GetDeps, GetDepsOk
 from accord_tpu.messages.recover import BeginRecovery, RecoverNack, RecoverOk
 from accord_tpu.messages.wait import WaitOnCommit
-from accord_tpu.local.status import SaveStatus
+from accord_tpu.local.status import InvalidIf, SaveStatus
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keys import Route
 from accord_tpu.primitives.timestamp import Ballot, Timestamp, TxnId
@@ -45,6 +45,11 @@ class Recover(Callback):
             ballot = Ballot(now.epoch, now.hlc, 0, node.id)
         self.ballot = ballot
         self.tracker: Optional[RecoveryTracker] = None
+        # per-shard quorum of InvalidIf evidence (coordinate/infer.py):
+        # when it fills, the decipher's invalidate decision commits off the
+        # BeginRecovery promise quorum itself — no ProposeInvalidate round
+        self.evidence_tracker: Optional[QuorumTracker] = None
+        self.evidence_quorum = False
         self.oks: Dict[int, RecoverOk] = {}
         self.ballot_promised = False
         self.done = False
@@ -63,6 +68,7 @@ class Recover(Callback):
         topologies = self.node.topology.precise_epochs(
             self.route.participants(), self.txn_id.epoch, self.txn_id.epoch)
         self.tracker = RecoveryTracker(topologies)
+        self.evidence_tracker = QuorumTracker(topologies)
         sent = 0
         for to in topologies.nodes():
             scope = TxnRequest.compute_scope(to, topologies, self.route)
@@ -93,6 +99,11 @@ class Recover(Callback):
         invariants.check_state(isinstance(reply, RecoverOk),
                                "unexpected reply %s", reply)
         self.oks[from_id] = reply
+        if getattr(reply, "invalid_if", InvalidIf.NOT_KNOWN_TO_BE_INVALID) \
+                >= InvalidIf.IF_UNDECIDED \
+                and self.evidence_tracker.record_success(from_id) \
+                == RequestStatus.SUCCESS:
+            self.evidence_quorum = True
         # this replica could only have cast a fast-path accept if it had
         # witnessed the txn at its original timestamp (Recover.onSuccess:
         # fastPath = ok.executeAt == txnId)
@@ -393,6 +404,26 @@ class Recover(Callback):
                 ballot=self.ballot).start()
 
     def _invalidate(self, merged: RecoverOk) -> None:
+        from accord_tpu.coordinate.infer import full_infer_enabled
+        if full_infer_enabled() and self.evidence_quorum \
+                and merged.status < SaveStatus.ACCEPTED:
+            # full Infer ladder (Infer.inferInvalidWithQuorum in the
+            # recovery path): a per-shard quorum of undecided replies
+            # carried durability evidence, and that same quorum already
+            # holds promises at self.ballot from the BeginRecovery round —
+            # a ProposeInvalidate round would only re-collect the promises
+            # we have.  The fence-refusal rule (Commands.is_durably_fenced)
+            # blocks any competing accept quorum below the fence, so the
+            # direct commit cannot race a late decision.
+            obs = getattr(self.node, "obs", None)
+            if obs is not None:
+                obs.flight.record("infer_invalidate", repr(self.txn_id),
+                                  ("recovery_quorum_evidence",
+                                   merged.status.name))
+            self.node.infer_stats["no_round_commits"] += 1
+            self._commit_invalidate(merged)
+            return
+
         def promised():
             if not self.done:
                 self._commit_invalidate(merged)

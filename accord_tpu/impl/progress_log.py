@@ -33,6 +33,14 @@ State-machine mapping vs the reference (r4 depth audit, VERDICT item 9):
   asserts the ladder cannot mask livelock by retrying forever.
 * Blocked disambiguation by blockedUntil (HasCommit/HasApply; :486) ->
   _BlockedState.until "Committed"/"Applied" with _blocked_satisfied.
+
+Infer ladder (coordinate/infer.py): both escalation paths prefer the
+quorum-inferred commit-invalidate over the multi-shard Invalidate round —
+_check_home's maybe_recover and _check_blocked's fetch_data each fold the
+per-reply InvalidIf evidence and, on a per-shard quorum of it, commit the
+invalidation with NO extra round (infer.infer_invalid_with_quorum);
+coordinate/invalidate.py remains the ballot-settled fallback for
+sub-quorum evidence, witnessed Accepts, and ACCORD_INFER_FULL=0.
 """
 
 from __future__ import annotations
@@ -315,10 +323,24 @@ class SimpleProgressLog(ProgressLog):
             find_route(self.node, state.txn_id,
                        state.participants).add_callback(learned)
             return
+        from accord_tpu.coordinate.infer import full_infer_enabled
         state.attempts += 1
         state.since_s = now
-        if state.attempts <= 2:
-            # cheap path first: pull the missing commit/apply from its shards
+        if state.attempts <= 2 or (state.attempts % 2 == 1
+                                   and full_infer_enabled()):
+            # cheap path first: pull the missing commit/apply from its
+            # shards — under the full Infer ladder this fetch ALSO settles
+            # a durability-fenced straggler outright (quorum InvalidIf
+            # evidence -> commit-invalidate, or a truncated-remotely dep
+            # installed as a local truncation), so the blocked chase never
+            # reaches the recover/Invalidate tier.  Under the full
+            # ladder, fetches stay INTERLEAVED past the recovery tier
+            # (odd attempts): the Propagate catch-up ladders (local
+            # truncation install; INSUFFICIENT + erased deps -> staleness
+            # escalation after 3 strikes) are driven by fetches, and
+            # recovery of an already-truncated txn succeeds without
+            # repairing the local copy — the r5 fetch-twice-then-recover-
+            # forever ladder left them unreachable (=0 keeps it)
             self._escalation(state.txn_id, "fetch_data", state.attempts)
             fetch_data(self.node, state.txn_id, route)
         else:

@@ -24,21 +24,48 @@ class Cleanup(enum.Enum):
     # lagging replica of this or another shard can still fetch the outcome
     TRUNCATE_WITH_OUTCOME = "TRUNCATE_WITH_OUTCOME"
     ERASE = "ERASE"
+    # safe-to-clean inference (coordinate/infer.py, reference
+    # Infer.safeToCleanup): an UNDECIDED local straggler below the
+    # universal durable bound is provably invalidated — had it been
+    # decided, it would have applied at EVERY replica including this one —
+    # so the sweep commits the invalidation locally and erases in one step
+    # instead of leaving it truncated-but-witnessable
+    INVALIDATE_THEN_ERASE = "INVALIDATE_THEN_ERASE"
 
 
 def should_cleanup(store, cmd) -> Cleanup:
     """GC decision for one command (Cleanup.shouldCleanup)."""
+    from accord_tpu.coordinate.infer import full_infer_enabled
     if cmd.is_truncated:
         return Cleanup.NO
     if cmd.is_invalidated:
-        # invalidated txns are safe to erase once universally durable bounds
-        # pass them (nobody can resurrect a lower ballot)
         participants = _participants(store, cmd)
-        if participants is not None and _fully(
-                store, "universal", cmd.txn_id, participants):
+        if participants is None:
+            return Cleanup.NO
+        # invalidated txns are safe to erase once universally durable
+        # bounds pass them (nobody can resurrect a lower ballot); the full
+        # Infer ladder erases already at the MAJORITY bound — resurrection
+        # would need a fresh witness quorum, which the fence-refusal rule
+        # (local/commands.is_durably_fenced) denies below that bound
+        if _fully(store, "universal", cmd.txn_id, participants):
+            return Cleanup.ERASE
+        if full_infer_enabled() and _fully(store, "majority", cmd.txn_id,
+                                           participants):
             return Cleanup.ERASE
         return Cleanup.NO
     if not cmd.has_been(SaveStatus.APPLIED):
+        if not full_infer_enabled() or cmd.save_status.is_decided:
+            return Cleanup.NO
+        participants = _participants(store, cmd)
+        if participants is None:
+            return Cleanup.NO
+        if _fully(store, "universal", cmd.txn_id, participants) \
+                and _post_bootstrap(store, cmd.txn_id, participants):
+            # undecided below the universal bound (and the range is not a
+            # gap in OUR history — post-bootstrap, not stale): every
+            # replica applied everything decided beneath the bound, we
+            # did not apply this, hence it was invalidated
+            return Cleanup.INVALIDATE_THEN_ERASE
         return Cleanup.NO
     participants = _participants(store, cmd)
     if participants is None:
@@ -50,6 +77,14 @@ def should_cleanup(store, cmd) -> Cleanup:
     if _fully(store, "majority", cmd.txn_id, participants):
         return Cleanup.TRUNCATE_WITH_OUTCOME
     return Cleanup.NO
+
+
+def _post_bootstrap(store, txn_id: TxnId, participants) -> bool:
+    """The local-inference gate: a pre-bootstrap or stale span is a hole in
+    OUR apply history, not evidence the txn never applied anywhere."""
+    from accord_tpu.local.watermarks import PreBootstrapOrStale
+    return store.redundant_before.pre_bootstrap_or_stale(
+        txn_id, participants) == PreBootstrapOrStale.POST_BOOTSTRAP
 
 
 def _participants(store, cmd):
@@ -104,6 +139,18 @@ def sweep(store) -> int:
         decision = should_cleanup(store, cmd)
         if decision == Cleanup.NO:
             continue
+        if decision == Cleanup.INVALIDATE_THEN_ERASE:
+            # safe-to-clean inference: settle the straggler as INVALIDATED
+            # first (terminal, listeners notified, progress log cleared),
+            # then erase — purge alone would stamp TRUNCATED_APPLY, whose
+            # Known projection falsely claims an applied outcome
+            obs = getattr(store.node, "obs", None)
+            if obs is not None:
+                obs.flight.record("infer_invalidate", repr(txn_id),
+                                  ("safe_to_clean", cmd.save_status.name))
+            store.node.infer_stats["safe_to_clean"] += 1
+            C.commit_invalidate(safe, txn_id)
+            decision = Cleanup.ERASE  # falls through to the common purge
         C.purge(safe, txn_id, erase=decision == Cleanup.ERASE,
                 keep_outcome=decision == Cleanup.TRUNCATE_WITH_OUTCOME)
         purged += 1

@@ -120,6 +120,32 @@ def is_shard_fenced(safe_store: SafeCommandStore, txn_id: TxnId,
     return any(rb.is_shard_redundant(txn_id, k) for k in participants)
 
 
+def is_durably_fenced(safe_store: SafeCommandStore, txn_id: TxnId,
+                      participants) -> bool:
+    """The full Infer ladder's refusal rule (coordinate/infer.py): a replica
+    must not FRESHLY witness, slow-path accept, or recovery-witness a txn
+    below its majority-durable fence — everything beneath the fence is
+    certified majority-applied-or-invalidated, so an unwitnessed straggler
+    there can only be headed for invalidation, and refusing makes the
+    quorum no-round invalidation provably safe (any future decision quorum
+    must intersect an evidence quorum of refusing replicas).  Only applies
+    to commands with NO local knowledge — a pre-fence witness stays live
+    (a fence cannot advance past a genuinely in-flight accept, whose
+    application the durability round awaits).  Off under
+    ACCORD_INFER_FULL=0, restoring the r5 executeAt-above-fence behavior."""
+    from accord_tpu.coordinate.infer import full_infer_enabled
+    if not full_infer_enabled():
+        return False
+    db = safe_store.store.durable_before
+    if isinstance(participants, Ranges):
+        fenced = db.is_any_majority_durable(txn_id, participants)
+    else:
+        fenced = any(db.is_majority_durable(txn_id, k) for k in participants)
+    if fenced:
+        safe_store.node.infer_stats["fence_refusals"] += 1
+    return fenced
+
+
 # ---------------------------------------------------------------- preaccept --
 
 def preaccept(safe_store: SafeCommandStore, txn_id: TxnId,
@@ -144,7 +170,8 @@ def preaccept(safe_store: SafeCommandStore, txn_id: TxnId,
         cmd.partial_txn = partial_txn
     participants = (partial_txn.keys if partial_txn is not None
                     else route.participants())
-    if is_shard_fenced(safe_store, txn_id, participants):
+    if is_shard_fenced(safe_store, txn_id, participants) \
+            or is_durably_fenced(safe_store, txn_id, participants):
         return AcceptOutcome.TRUNCATED, None
     witnessed_at = propose_execute_at(safe_store, txn_id, participants,
                                       permit_fast_path=ballot == Ballot.ZERO)
@@ -187,9 +214,16 @@ def recover(safe_store: SafeCommandStore, txn_id: TxnId,
         cmd.partial_txn = partial_txn
     participants = (partial_txn.keys if partial_txn is not None
                     else route.participants())
-    # NB: no shard-fence gate here, unlike preaccept: a fresh recovery
+    # NB: no SHARD-fence gate here, unlike preaccept: a fresh recovery
     # witness votes slow-path with executeAt above the fence (safe), whereas
-    # refusing could fabricate evidence against a decided-elsewhere txn
+    # refusing could fabricate evidence against a decided-elsewhere txn.
+    # The DURABLE fence is different (full Infer ladder): a decided txn
+    # below it is majority-APPLIED, so a fresh local witness here proves
+    # nothing was decided through us — refusal fabricates no evidence, and
+    # is what makes the quorum no-round invalidation sound (the promise
+    # above still stands, so the refusing reply keeps its ballot guard)
+    if is_durably_fenced(safe_store, txn_id, participants):
+        return AcceptOutcome.TRUNCATED, cmd
     witnessed_at = propose_execute_at(safe_store, txn_id, participants,
                                       permit_fast_path=False,
                                       permit_expiry=False)
@@ -217,6 +251,13 @@ def accept(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot,
         return AcceptOutcome.REJECTED_BALLOT
     if cmd.has_been(SaveStatus.PRE_COMMITTED):
         return AcceptOutcome.REDUNDANT
+    if not cmd.has_been(SaveStatus.PRE_ACCEPTED) \
+            and is_durably_fenced(safe_store, txn_id, participating_keys):
+        # full Infer ladder: an accept may not FRESHLY witness below the
+        # durable fence either, or a recovery's Propose could complete a
+        # decision quorum behind a quorum-established invalidation
+        # inference (coordinate/infer.py safety argument)
+        return AcceptOutcome.TRUNCATED
 
     cmd.update_route(route)
     cmd.set_promised(ballot)
@@ -796,6 +837,37 @@ def set_durability(safe_store: SafeCommandStore, txn_id: TxnId,
 
 
 # --------------------------------------------------------------- truncation --
+
+def set_truncated_remotely(safe_store: SafeCommandStore, txn_id: TxnId,
+                           execute_at: Optional[Timestamp] = None) -> bool:
+    """Install a truncation learned from peers (full Infer ladder,
+    reference Propagate's Infer.safeToCleanup arm): the interrogated
+    quorum showed the txn durably decided+applied and SHED, with no
+    outcome left to fetch — the local undecided copy can never decide
+    (fence refusal) and the txn will never execute here, so local waiters
+    must stop chasing it.  Mirrors purge()'s TRUNCATED_APPLY terminal
+    without its already-durable invariant (the durability here is the
+    REMOTE quorum's, witnessed through CheckStatus).  Returns True when
+    the truncation was installed."""
+    cmd = safe_store.get(txn_id)
+    if cmd.save_status.is_decided or cmd.is_truncated:
+        return False
+    if execute_at is not None and cmd.execute_at is None:
+        cmd.execute_at = execute_at
+    cmd.partial_txn = None
+    cmd.partial_deps = None
+    cmd.stable_deps = None
+    cmd.waiting_on = None
+    safe_store.store.gated.pop(txn_id, None)
+    note_status_transition(txn_id, cmd.save_status,
+                           SaveStatus.TRUNCATED_APPLY)
+    cmd.save_status = SaveStatus.TRUNCATED_APPLY
+    safe_store.store.insufficient_catchups.pop(txn_id, None)
+    safe_store.register(cmd, InternalStatus.INVALID_OR_TRUNCATED)
+    safe_store.progress_log.clear(txn_id)
+    _notify_listeners(safe_store, cmd)
+    return True
+
 
 def purge(safe_store: SafeCommandStore, txn_id: TxnId,
           erase: bool = False, keep_outcome: bool = False) -> None:

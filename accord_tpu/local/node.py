@@ -121,17 +121,22 @@ class Node:
         # recovery_attempts_max keeps the high-water mark, burn-asserted)
         self.recovery_attempts: Dict[TxnId, int] = {}
         self.recovery_attempts_max = 0
-        # pricing the Infer narrowing (coordinate/infer.py vs reference
+        # the Infer ladder's A/B counters (coordinate/infer.py, reference
         # Infer.inferInvalidWithQuorum): evidence = CheckStatus merges whose
-        # replies carried invalid-if-undecided; quorum_evidence = merges
-        # where a MAJORITY of contacted replicas carried it (the cases the
-        # reference invalidates with ZERO extra rounds); inferred_rounds =
-        # ballot-protected Invalidate rounds we launched on that evidence.
+        # replies carried InvalidIf evidence; quorum_evidence = merges where
+        # a per-shard QUORUM carried it (resolvable with ZERO extra rounds);
+        # inferred_rounds = ballot-protected Invalidate rounds still paid on
+        # evidence (sub-quorum, or the ACCORD_INFER_FULL=0 escape hatch);
+        # no_round_commits = invalidations committed directly off quorum
+        # evidence; fence_refusals = fresh witnesses refused below the
+        # durable fence (local/commands.is_durably_fenced); safe_to_clean =
+        # stragglers the cleanup sweep inferred invalid and erased.
         # Registry-backed with the old dict shape preserved (the r5 Infer
         # A/B harness reads these keys).
         self.infer_stats = CounterDict(
             self.obs.registry, "accord_infer_total",
-            ("evidence", "quorum_evidence", "inferred_rounds"))
+            ("evidence", "quorum_evidence", "inferred_rounds",
+             "no_round_commits", "fence_refusals", "safe_to_clean"))
         self._reply_seq = 0
         # epochs with a live shared refetch timer chain (_ensure_epoch_fetch)
         self._epoch_refetch: set = set()
@@ -503,6 +508,21 @@ class Node:
         self._process(request, from_id, reply_context)
 
     def _process(self, request: Request, from_id: int, reply_context) -> None:
+        # HLC merge on receipt: every timestamp this node witnesses must be
+        # absorbed so its next mint sorts after it.  Witnessing used to
+        # absorb incidentally (propose_execute_at's unique_now_at_least),
+        # but the Infer ladder's fence refusal declines to witness at all —
+        # without the explicit merge a refused replica's clock could trail
+        # journaled remote timestamps, and a crash between the refusal and
+        # the next local mint would rely solely on the replay HLC fold for
+        # the never-reissue-a-used-TxnId guarantee (tests/test_wal.py pins
+        # the live half of it).
+        req_txn_id = getattr(request, "txn_id", None)
+        if req_txn_id is not None:
+            self.on_remote_timestamp(req_txn_id)
+        req_execute_at = getattr(request, "execute_at", None)
+        if req_execute_at is not None:
+            self.on_remote_timestamp(req_execute_at)
         tid = getattr(request, "trace_id", None)
         mt = request.type
         verb = mt.label if mt is not None else type(request).__name__

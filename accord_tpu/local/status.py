@@ -257,29 +257,59 @@ class KnownOutcome(enum.IntEnum):
     ERASED = 3
 
 
+class InvalidIf(enum.IntEnum):
+    """Invalidation-evidence lattice carried per range on CheckStatus
+    replies (reference coordinate/Infer.InvalidIf): each point names the
+    CONDITION under which the replying replica's durability state proves
+    the transaction invalid.  Totally ordered by evidence strength —
+    lattice join is max — so merging replies keeps the strongest proof.
+
+    IF_UNDECIDED: the txn sits below the replica's majority-durable fence
+    (DurableBefore), which certifies everything beneath it as
+    majority-applied-or-invalidated; a quorum of such replies that all
+    find the txn undecided therefore proves it was never decided — and,
+    with the fence-refusal rule (local/commands.py is_durably_fenced),
+    never can be.  IF_UNCOMMITTED: additionally below the shard-applied
+    fence (every replica applied the exclusive sync point and refuses new
+    witnesses).  IS_INVALID: locally known invalidated."""
+
+    NOT_KNOWN_TO_BE_INVALID = 0
+    IF_UNDECIDED = 1
+    IF_UNCOMMITTED = 2
+    IS_INVALID = 3
+
+
 class Known:
     """The knowledge vector lattice (Status.java:124+): per-field max-merge."""
 
-    __slots__ = ("route", "definition", "execute_at", "deps", "outcome")
+    __slots__ = ("route", "definition", "execute_at", "deps", "outcome",
+                 "invalid_if")
 
     NOTHING: "Known"
     INVALIDATED: "Known"
 
     def __init__(self, route: KnownRoute, definition: KnownDefinition,
                  execute_at: KnownExecuteAt, deps: KnownDeps,
-                 outcome: KnownOutcome):
+                 outcome: KnownOutcome,
+                 invalid_if: InvalidIf = InvalidIf.NOT_KNOWN_TO_BE_INVALID):
         self.route = route
         self.definition = definition
         self.execute_at = execute_at
         self.deps = deps
         self.outcome = outcome
+        self.invalid_if = invalid_if
+
+    def with_invalid_if(self, invalid_if: InvalidIf) -> "Known":
+        return Known(self.route, self.definition, self.execute_at,
+                     self.deps, self.outcome, invalid_if)
 
     def at_least(self, other: "Known") -> "Known":
         return Known(max(self.route, other.route),
                      max(self.definition, other.definition),
                      max(self.execute_at, other.execute_at),
                      max(self.deps, other.deps),
-                     max(self.outcome, other.outcome))
+                     max(self.outcome, other.outcome),
+                     max(self.invalid_if, other.invalid_if))
 
     merge = at_least
 
@@ -301,14 +331,20 @@ class Known:
                      min(self.definition, other.definition),
                      max(self.execute_at, other.execute_at),
                      min(self.deps, other.deps),
-                     max(self.outcome, other.outcome))
+                     max(self.outcome, other.outcome),
+                     # invalidation evidence is GLOBAL (a txn commits
+                     # everywhere or nowhere): one range's durability fence
+                     # condemns the whole txn, so the reduce joins like
+                     # executeAt/outcome rather than taking the minimum
+                     max(self.invalid_if, other.invalid_if))
 
     def satisfies(self, required: "Known") -> bool:
         return (self.route >= required.route
                 and self.definition >= required.definition
                 and self.execute_at >= required.execute_at
                 and self.deps >= required.deps
-                and self.outcome >= required.outcome)
+                and self.outcome >= required.outcome
+                and self.invalid_if >= required.invalid_if)
 
     @property
     def is_invalidated(self) -> bool:
@@ -320,16 +356,20 @@ class Known:
                 and self.definition == other.definition
                 and self.execute_at == other.execute_at
                 and self.deps == other.deps
-                and self.outcome == other.outcome)
+                and self.outcome == other.outcome
+                and self.invalid_if == other.invalid_if)
 
     def __hash__(self):
         return hash((self.route, self.definition, self.execute_at, self.deps,
-                     self.outcome))
+                     self.outcome, self.invalid_if))
 
     def __repr__(self):
         return (f"Known(route={self.route.name}, def={self.definition.name}, "
                 f"at={self.execute_at.name}, deps={self.deps.name}, "
-                f"out={self.outcome.name})")
+                f"out={self.outcome.name}"
+                + (f", inv={self.invalid_if.name}"
+                   if self.invalid_if != InvalidIf.NOT_KNOWN_TO_BE_INVALID
+                   else "") + ")")
 
 
 Known.NOTHING = Known(KnownRoute.MAYBE, KnownDefinition.NO,
@@ -337,7 +377,7 @@ Known.NOTHING = Known(KnownRoute.MAYBE, KnownDefinition.NO,
                       KnownOutcome.UNKNOWN)
 Known.INVALIDATED = Known(KnownRoute.MAYBE, KnownDefinition.NO,
                           KnownExecuteAt.NO, KnownDeps.NO,
-                          KnownOutcome.INVALIDATED)
+                          KnownOutcome.INVALIDATED, InvalidIf.IS_INVALID)
 
 # Common knowledge targets used by FetchData/CheckStatus (reference Known statics)
 KNOWN_COMMITTED = Known(KnownRoute.COVERING, KnownDefinition.NO,

@@ -11,7 +11,8 @@ from __future__ import annotations
 import enum
 from typing import Optional
 
-from accord_tpu.local.status import Durability, Known, ProgressToken, SaveStatus
+from accord_tpu.local.status import (Durability, InvalidIf, Known,
+                                     ProgressToken, SaveStatus)
 from accord_tpu.messages.base import MessageType, Reply, TxnRequest
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keys import Range, Ranges, Route
@@ -120,9 +121,10 @@ class CheckStatusOk(Reply):
         self.writes = writes
         self.result = result
         # durability-derived evidence this txn is headed for invalidation
-        # (coordinate/infer.py); steers the fetcher's escalation into the
-        # ballot-backed Invalidate round — NOT a licence to invalidate
-        # without one (see infer.py's safety note)
+        # (coordinate/infer.py); under ACCORD_INFER_FULL=0 it steers the
+        # fetcher's escalation into the ballot-backed Invalidate round;
+        # the full ladder instead reads the per-range InvalidIf lattice
+        # carried inside known_map (see invalid_if below)
         self.invalid_if_undecided = invalid_if_undecided
         # per-range knowledge provenance; None only for legacy/hand-built
         # replies, in which case known_for falls back to the global vector
@@ -188,6 +190,17 @@ class CheckStatusOk(Reply):
             return self.save_status.known()
         return self.known_map.known_for(participants)
 
+    @property
+    def invalid_if(self) -> InvalidIf:
+        """The strongest per-range invalidation condition any span of this
+        reply carries (Infer.InvalidIf via the KnownMap lattice join) —
+        evidence is global, so the span-wise at_least union is the reply's
+        claim.  Legacy replies degrade to the boolean projection."""
+        if self.known_map is None:
+            return (InvalidIf.IF_UNDECIDED if self.invalid_if_undecided
+                    else InvalidIf.NOT_KNOWN_TO_BE_INVALID)
+        return self.known_map.known_for_any().invalid_if
+
     def to_progress_token(self) -> ProgressToken:
         """Progress summary for liveness comparisons
         (CheckStatusOk.toProgressToken)."""
@@ -215,7 +228,8 @@ class CheckStatus(TxnRequest):
         self.include_info = include_info
 
     def apply(self, safe_store) -> Reply:
-        from accord_tpu.coordinate.infer import invalid_if_undecided
+        from accord_tpu.coordinate.infer import (invalid_if_for_span,
+                                                 invalid_if_undecided)
         cmd = safe_store.if_present(self.txn_id)
         undecided = cmd is None or not cmd.save_status.is_decided
         proof = (undecided and invalid_if_undecided(
@@ -224,12 +238,25 @@ class CheckStatus(TxnRequest):
         # its ranges actually cover (FoundKnownMap.create over command-store
         # ranges, CheckStatus.java:326)
         owned = self.scope.owned_participants(safe_store.ranges)
+        known = (Known.NOTHING if cmd is None else cmd.save_status.known())
+        if undecided:
+            # attach the per-range InvalidIf lattice (Infer.invalidIfNot):
+            # each owned span reports the strongest condition ITS durability
+            # watermarks justify, so a partial-quorum merge cannot borrow
+            # one shard's fence for another's spans
+            m = ReducingRangeMap()
+            for s, e in _token_spans(owned):
+                k = known.with_invalid_if(
+                    invalid_if_for_span(safe_store, self.txn_id, s, e))
+                m = m.update(s, e, k, Known.at_least)
+            known_map = KnownMap(m)
+        else:
+            known_map = KnownMap.create(owned, known)
         if cmd is None:
             return CheckStatusOk(SaveStatus.NOT_DEFINED, Ballot.ZERO,
                                  Ballot.ZERO, None, Durability.NOT_DURABLE,
                                  None, invalid_if_undecided=proof,
-                                 known_map=KnownMap.create(owned,
-                                                           Known.NOTHING))
+                                 known_map=known_map)
         full = self.include_info == IncludeInfo.ALL
         return CheckStatusOk(
             cmd.save_status, cmd.promised, cmd.accepted_ballot,
@@ -241,7 +268,7 @@ class CheckStatus(TxnRequest):
             writes=cmd.writes if full else None,
             result=cmd.result if full else None,
             invalid_if_undecided=proof,
-            known_map=KnownMap.create(owned, cmd.save_status.known()))
+            known_map=known_map)
 
     def reduce(self, a: Reply, b: Reply) -> Reply:
         if isinstance(a, CheckStatusNack):

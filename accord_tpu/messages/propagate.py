@@ -38,10 +38,28 @@ class Propagate(TxnRequest):
         if k.save_status == SaveStatus.INVALIDATED:
             C.commit_invalidate(safe_store, self.txn_id)
             return SimpleReply(SimpleReply.OK)
-        if k.save_status.is_truncated and (k.writes is None
-                                           or k.execute_at is None):
-            # remote state is gone without a retained outcome; nothing to
-            # learn here (Infer territory)
+        from accord_tpu.coordinate.infer import full_infer_enabled
+        if k.save_status.is_truncated \
+                and (k.writes is None or k.execute_at is None
+                     or (full_infer_enabled()
+                         and self.txn_id.kind.is_read)):
+            # remote state is durably decided+applied and SHED, with no
+            # outcome this store could still need: an erased write, or a
+            # read — whose retained Writes object is vacuous, yet used to
+            # route it into the apply tier where its erased deps struck
+            # endless INSUFFICIENT catch-ups (a read below the fence can
+            # never execute here and has nothing to install).  Full Infer
+            # ladder: install the truncation locally (Infer.safeToCleanup
+            # via Propagate in the reference) so local waiters stop
+            # chasing it — the fence-refusal rule means our undecided
+            # copy can never decide it either.  Under ACCORD_INFER_FULL=0
+            # this stays the documented narrowing: nothing to learn from
+            # an outcome-less truncation, and truncated reads keep
+            # routing through the apply tier's INSUFFICIENT staleness
+            # strikes.
+            if full_infer_enabled() and not cmd.save_status.is_decided:
+                C.set_truncated_remotely(safe_store, self.txn_id,
+                                         k.execute_at)
             return SimpleReply(SimpleReply.OK)
 
         # what the merged reply actually justifies for THIS store's slice of

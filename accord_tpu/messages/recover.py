@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Optional
 
 from accord_tpu.local import commands as C
-from accord_tpu.local.status import KnownDeps, SaveStatus
+from accord_tpu.local.status import InvalidIf, KnownDeps, SaveStatus
 from accord_tpu.messages.base import MessageType, Reply, TxnRequest
 from accord_tpu.primitives.deps import Deps
 from accord_tpu.primitives.keys import Key, Keys, Route
@@ -32,7 +32,8 @@ class RecoverOk(Reply):
                  rejects_fast_path: bool,
                  earlier_committed_witness: Deps,
                  earlier_no_witness: Deps,
-                 unresolved_covers: Deps = Deps.NONE):
+                 unresolved_covers: Deps = Deps.NONE,
+                 invalid_if: InvalidIf = InvalidIf.NOT_KNOWN_TO_BE_INVALID):
         self.txn_id = txn_id
         self.status = status
         self.accepted_ballot = accepted_ballot
@@ -52,6 +53,13 @@ class RecoverOk(Reply):
         # the coordinator must await their commit and retry before reading
         # the fast-path decipher either way
         self.unresolved_covers = unresolved_covers
+        # durability-derived invalidation evidence (coordinate/infer.py):
+        # the strongest InvalidIf condition this replica's watermarks
+        # justify over the queried participants, attached only when the
+        # txn is locally undecided.  A per-shard quorum of these lets the
+        # recovering coordinator commit invalidation off its own promise
+        # round, skipping the ProposeInvalidate round entirely
+        self.invalid_if = invalid_if
 
     @property
     def witnessed_at_original(self) -> bool:
@@ -99,7 +107,8 @@ class RecoverOk(Reply):
             hi.result if hi.result is not None else lo.result,
             self.rejects_fast_path or other.rejects_fast_path,
             witness, no_witness,
-            self.unresolved_covers.with_(other.unresolved_covers))
+            self.unresolved_covers.with_(other.unresolved_covers),
+            invalid_if=max(self.invalid_if, other.invalid_if))
 
     def __repr__(self):
         return (f"RecoverOk({self.txn_id!r}, {self.status.name}, "
@@ -129,15 +138,28 @@ class BeginRecovery(TxnRequest):
         self.partial_txn = partial_txn
 
     def apply(self, safe_store) -> Reply:
+        from accord_tpu.coordinate.infer import invalid_if_local
         outcome, cmd = C.recover(safe_store, self.txn_id, self.partial_txn,
                                  self.route, self.ballot)
         if outcome == C.AcceptOutcome.REJECTED_BALLOT:
             return RecoverNack(cmd.promised)
         if outcome == C.AcceptOutcome.TRUNCATED:
-            # genuinely invalidated or locally shed: report what we know
+            # genuinely invalidated, locally shed, or a fence REFUSAL
+            # (Commands.recover's durable-fence gate): report what we know,
+            # attaching the InvalidIf evidence when undecided so the
+            # coordinator can fold a quorum of refusals into a no-round
+            # commit-invalidate (coordinate/infer.py)
+            evidence = InvalidIf.NOT_KNOWN_TO_BE_INVALID
+            if cmd.save_status == SaveStatus.INVALIDATED:
+                evidence = InvalidIf.IS_INVALID
+            elif not cmd.save_status.is_decided:
+                evidence = invalid_if_local(
+                    safe_store, self.txn_id,
+                    self._local_keys(safe_store, cmd))
             return RecoverOk(self.txn_id, cmd.save_status, cmd.accepted_ballot,
                              cmd.execute_at, LatestDeps.EMPTY, None,
-                             None, None, False, Deps.NONE, Deps.NONE)
+                             None, None, False, Deps.NONE, Deps.NONE,
+                             invalid_if=evidence)
 
         keys = self._local_keys(safe_store, cmd)
         local_deps = None
@@ -168,10 +190,14 @@ class BeginRecovery(TxnRequest):
         latest = LatestDeps.create(safe_store.ranges, known_deps,
                                    cmd.accepted_ballot, coordinated,
                                    local_deps)
+        evidence = (invalid_if_local(safe_store, self.txn_id, keys)
+                    if not cmd.save_status.is_decided
+                    else InvalidIf.NOT_KNOWN_TO_BE_INVALID)
         return RecoverOk(
             self.txn_id, cmd.save_status, cmd.accepted_ballot, cmd.execute_at,
             latest, cmd.partial_txn, cmd.writes, cmd.result,
-            rejects, earlier_witness, earlier_no_witness, unresolved_covers)
+            rejects, earlier_witness, earlier_no_witness, unresolved_covers,
+            invalid_if=evidence)
 
     def _local_keys(self, safe_store, cmd):
         """Participants (Keys or Ranges) for deps calc + decipher predicates."""

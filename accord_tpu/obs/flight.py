@@ -63,6 +63,15 @@ EVENT_KINDS = {
                             "(journal/replay.py); data=(records,)",
     "journal_replay_end": "crash-restart journal replay finished "
                           "(journal/replay.py); data=(records, txns)",
+    "infer_evidence": "per-shard quorum of InvalidIf invalidation evidence "
+                      "established by a CheckStatus round "
+                      "(coordinate/fetch.py); data=(evidence_replies, "
+                      "contacted)",
+    "infer_invalidate": "invalidation committed with no extra round off "
+                        "quorum evidence, or inferred locally by the "
+                        "safe-to-clean sweep (coordinate/infer.py, "
+                        "coordinate/recover.py, local/cleanup.py); "
+                        "data=(site, merged_status)",
 }
 
 

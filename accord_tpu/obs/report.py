@@ -72,6 +72,22 @@ def _hist_report(h: dict) -> dict:
             "p95": snapshot_quantile(h, 0.95)}
 
 
+def _infer_section(metrics: dict) -> dict:
+    """The Infer-ladder A/B (coordinate/infer.py): every
+    accord_infer_total kind, plus the no-round ratio — of the
+    interrogations that established a per-shard evidence quorum
+    (resolvable with zero extra rounds), how many the active
+    configuration actually settled without a ballot round.  Comparing
+    this section across ACCORD_INFER_FULL=0/1 snapshots of the same seed
+    IS the pricing comparison (tests/test_infer.py)."""
+    kinds = _counter_by_label(metrics, "accord_infer_total", "kind")
+    quorum = kinds.get("quorum_evidence", 0)
+    no_round = kinds.get("no_round_commits", 0)
+    kinds["no_round_ratio"] = (round(no_round / quorum, 4)
+                               if quorum else None)
+    return kinds
+
+
 def summarize(metrics: dict) -> dict:
     paths = _counter_by_label(metrics, "accord_path_total", "path")
     fast = paths.get("fast", 0)
@@ -119,7 +135,7 @@ def summarize(metrics: dict) -> dict:
             "batch_size_max": _gauge_max(metrics,
                                          "accord_pipeline_batch_size_max"),
         },
-        "infer": _counter_by_label(metrics, "accord_infer_total", "kind"),
+        "infer": _infer_section(metrics),
         "journal": {
             "appends": _counter_total(metrics,
                                       "accord_journal_appends_total"),

@@ -625,18 +625,25 @@ def main(argv=None) -> int:
                       f"batch_max={max(s.batch_size_max for s in ps)} "
                       f"batch_mean="
                       f"{sum(s.dispatched for s in ps) / max(1, sum(s.batches for s in ps)):.1f}]")
-        inf = {"evidence": 0, "quorum_evidence": 0, "inferred_rounds": 0}
+        inf = {"evidence": 0, "quorum_evidence": 0, "inferred_rounds": 0,
+               "no_round_commits": 0, "fence_refusals": 0,
+               "safe_to_clean": 0}
         for node in run.cluster.nodes.values():
             for k in inf:
                 inf[k] += node.infer_stats[k]
         if any(inf.values()):
-            # pricing the Infer narrowing (VERDICT r4 #8): quorum_evidence
-            # counts interrogations the reference's inferInvalidWithQuorum
-            # would settle with no extra round; inferred_rounds is what we
-            # actually paid in ballot-protected Invalidate rounds
+            # the Infer ladder A/B (coordinate/infer.py): quorum_evidence
+            # counts interrogations resolvable with no extra round;
+            # no_round_commits is how many the full ladder settled that
+            # way; inferred_rounds is what was still paid in
+            # ballot-protected Invalidate rounds (sub-quorum evidence or
+            # the ACCORD_INFER_FULL=0 escape hatch)
             extra += (f" infer[evidence={inf['evidence']} "
                       f"quorum_evidence={inf['quorum_evidence']} "
-                      f"inferred_rounds={inf['inferred_rounds']}]")
+                      f"inferred_rounds={inf['inferred_rounds']} "
+                      f"no_round={inf['no_round_commits']} "
+                      f"fence_refusals={inf['fence_refusals']} "
+                      f"safe_to_clean={inf['safe_to_clean']}]")
 
         def lat(pct):
             us = stats.latency_us(pct)

@@ -309,6 +309,36 @@ def test_burn_hostile_crash_restart_full_nemesis(tmp_path):
     assert journal["replay_records"] > 0
 
 
+def test_burn_hostile_infer_ladder_crash_restart(tmp_path, monkeypatch):
+    """Infer-ladder hostile acceptance (ISSUE 5): the full nemesis stack —
+    drops, scheduled partitions, clock drift, topology churn — COMPOSED
+    with the crash-restart nemesis, under ACCORD_INFER_FULL=1.  All three
+    checkers (verify + Elle + journal reconstruction) run inside
+    BurnRun.run; across the churn seeds the interrogations must establish
+    per-shard quorum evidence (accord_infer_total{kind=quorum_evidence}
+    >= 1) and the full ladder must never pay a ballot-protected round for
+    it (inferred_rounds stays 0 — no sub-quorum-evidence escalations fired
+    on these seeds, measured: 2-5 quorum merges each)."""
+    monkeypatch.setenv("ACCORD_INFER_FULL", "1")
+    totals = {}
+    for seed in (27, 88):
+        run = BurnRun(seed, 120, drop_prob=0.1, partitions=True,
+                      clock_drift=True, restarts=1,
+                      journal_dir=str(tmp_path / str(seed)))
+        stats = run.run()
+        assert stats.acks > 0, f"seed {seed}: no transaction succeeded"
+        assert stats.lost == 0 and stats.pending == 0, f"seed {seed}"
+        assert stats.restarts == 1
+        assert run.partition_nemesis.partitions_applied > 0
+        assert run.journal_checked > 0
+        infer = run.metrics_snapshot()["summary"]["infer"]
+        for k, v in infer.items():
+            if isinstance(v, int):
+                totals[k] = totals.get(k, 0) + v
+    assert totals["quorum_evidence"] >= 1, totals
+    assert totals["inferred_rounds"] == 0, totals
+
+
 def test_burn_recovery_storm_bounded():
     """Recovery-storm boundedness under 25% loss (VERDICT r3 item 9):
     watchdog-driven retry must not mask livelock.  Measured behaviour on

@@ -152,6 +152,26 @@ def test_every_flight_event_kind_is_documented():
         assert len(desc) > 20 and "/" in desc, (kind, desc)
 
 
+def test_infer_ladder_kinds_are_covered():
+    """The Infer ladder's inference sites must stay on the forensics ring:
+    quorum evidence established (coordinate/fetch.py) and every no-round /
+    safe-to-clean invalidation commit (coordinate/infer.py,
+    coordinate/recover.py, local/cleanup.py), each stamped with the txn
+    trace id.  Pinned as a SET like the journal lifecycle below, so a
+    hook cannot vanish together with its EVENT_KINDS row."""
+    recorded = _recorded_flight_kinds()
+    assert "infer_evidence" in EVENT_KINDS
+    assert "infer_invalidate" in EVENT_KINDS
+    assert any(p.startswith("coordinate") for p in
+               recorded.get("infer_evidence", [])), recorded.get(
+                   "infer_evidence")
+    sites = recorded.get("infer_invalidate", [])
+    # all three inference tiers record the commit: the fetch/recovery
+    # quorum paths and the cleanup sweep's local deduction
+    assert any(p.startswith("coordinate") for p in sites), sites
+    assert any(p.startswith("local") for p in sites), sites
+
+
 def test_journal_lifecycle_kinds_are_covered():
     """The durable WAL's full lifecycle must stay on the forensics ring:
     append, segment rotation, snapshot compaction, and both replay edges.

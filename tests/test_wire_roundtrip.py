@@ -150,6 +150,35 @@ class _Gen:
                           {Key(self.token()): (1, 2 + self.token())},
                           {Key(self.token()): self.token()})
 
+    def known(self, invalid_if=None):
+        from accord_tpu.local.status import (InvalidIf, Known,
+                                             KnownDefinition, KnownDeps,
+                                             KnownExecuteAt, KnownOutcome,
+                                             KnownRoute)
+        pick = lambda e: list(e)[self.rng.next_int(0, len(e) - 1)]
+        return Known(pick(KnownRoute), pick(KnownDefinition),
+                     pick(KnownExecuteAt), pick(KnownDeps),
+                     pick(KnownOutcome),
+                     invalid_if if invalid_if is not None
+                     else pick(InvalidIf))
+
+    def check_status_ok(self, invalid_if=None, route=None):
+        """A CheckStatusOk whose KnownMap carries per-range Known vectors —
+        including the InvalidIf lattice point the full Infer ladder rides
+        on the wire (every point must encode+decode canonically)."""
+        from accord_tpu.local.status import Durability, SaveStatus
+        from accord_tpu.messages.checkstatus import CheckStatusOk, KnownMap
+        route = route if route is not None else self.route()
+        states = list(SaveStatus)
+        return CheckStatusOk(
+            states[self.rng.next_int(0, len(states) - 1)],
+            self.ballot(), self.ballot(), self.ts(),
+            Durability(self.rng.next_int(0, 4)), route,
+            is_coordinating=self.rng.next_bool(),
+            invalid_if_undecided=self.rng.next_bool(),
+            known_map=KnownMap.create(route.participants(),
+                                      self.known(invalid_if=invalid_if)))
+
 
 def _synthesize(gen: _Gen):
     """One randomized instance of every verb the burn cannot reach."""
@@ -199,6 +228,9 @@ def _synthesize(gen: _Gen):
                         gen.ts()),
         FetchSnapshotNack(),
         FailureReply(Timeout("synthesized")),
+        # the extended CheckStatusOk/KnownMap wire shape (Infer ladder):
+        # randomized Known vectors incl. the InvalidIf lattice component
+        gen.check_status_ok(),
     ]
     return out
 
@@ -239,6 +271,45 @@ def test_every_registered_verb_round_trips(harvested):
             _assert_round_trip(msg)
             checked += 1
     assert checked >= len(want)
+
+
+def test_invalid_if_lattice_round_trips_canonically():
+    """Every InvalidIf lattice point must survive the wire inside the
+    per-range KnownMap (the full Infer ladder's evidence channel), both as
+    a CheckStatusOk and folded through CheckStatusOk.merge, and the
+    RecoverOk reply-level summary must carry it too — a codec asymmetry
+    here would silently strip invalidation evidence and re-open the
+    narrowing this harness exists to pin (it caught two real codec bugs
+    in PR 4)."""
+    from accord_tpu.local.status import InvalidIf
+    from accord_tpu.messages.checkstatus import CheckStatusOk
+
+    for i, point in enumerate(InvalidIf):
+        gen = _Gen(2000 + i)
+        msg = gen.check_status_ok(invalid_if=point)
+        _assert_round_trip(msg)
+        decoded = decode_message(json.loads(json.dumps(encode_message(msg))))
+        assert decoded.invalid_if == point
+        assert decoded.known_map.known_for_any().invalid_if == point
+        # merge keeps the lattice join across the wire boundary
+        weaker = gen.check_status_ok(
+            invalid_if=InvalidIf.NOT_KNOWN_TO_BE_INVALID,
+            route=msg.route)
+        assert decoded.merge(weaker).invalid_if == point
+
+    # RecoverOk's reply-level InvalidIf (recovery path of the ladder)
+    from accord_tpu.messages.recover import RecoverOk
+    from accord_tpu.primitives.deps import Deps
+    from accord_tpu.primitives.latest_deps import LatestDeps
+    from accord_tpu.local.status import SaveStatus
+    for i, point in enumerate(InvalidIf):
+        gen = _Gen(3000 + i)
+        ok = RecoverOk(gen.txn_id(), SaveStatus.NOT_DEFINED, gen.ballot(),
+                       None, LatestDeps.EMPTY, None, None, None, False,
+                       Deps.NONE, Deps.NONE, invalid_if=point)
+        _assert_round_trip(ok)
+        decoded = decode_message(json.loads(json.dumps(encode_message(ok))))
+        assert decoded.invalid_if == point
 
 
 def test_round_trip_preserves_trace_id(harvested):
