@@ -50,6 +50,11 @@ class CoordinateEphemeralRead:
     def start(self) -> None:
         self.deps_oks.clear()
         self.generation += 1
+        # per-phase SLO attribution (obs/spans.PHASE_ORDER): the ephemeral
+        # path's two rounds are milestones like preaccept/commit are for
+        # witnessed txns; an epoch-advance redo re-stamps (first one wins)
+        self.node.obs.txn_phase(self.txn_id, "eph_deps",
+                                epoch=self.epoch)
         cb = RoundCallback(self, ("deps", self.generation))
         topologies = self.node.topology.with_unsynced_epochs(
             self.route.participants(), self.txn_id.epoch, self.epoch)
@@ -121,6 +126,7 @@ class CoordinateEphemeralRead:
     # ------------------------------------------------------- read round --
     def _start_read(self) -> None:
         from accord_tpu.coordinate.read_coord import ReadCoordinator
+        self.node.obs.txn_phase(self.txn_id, "eph_read")
         self.reading = True
         self.generation += 1
         selected = self.node.topology.current().for_selection(

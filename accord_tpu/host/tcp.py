@@ -39,9 +39,18 @@ from accord_tpu.utils.random_source import RandomSource
 _LEN = struct.Struct(">I")
 
 
-def _build_list_txn(read_tokens, appends: Dict[int, int]) -> Txn:
+def _build_list_txn(read_tokens, appends: Dict[int, int],
+                    ephemeral: bool = False) -> Txn:
     """List-register read/append txn (shared by the in-process and wire
-    client paths)."""
+    client paths).  `ephemeral` routes a pure read down the single-round
+    invisible EPHEMERAL_READ path (coordinate/ephemeral.py) — the
+    workload harness's read-heavy SLO lane."""
+    if ephemeral:
+        assert read_tokens and not appends, \
+            "ephemeral txns are pure reads"
+        keys = Keys.of(*read_tokens)
+        return Txn(TxnKind.EPHEMERAL_READ, keys, read=ListRead(keys),
+                   query=ListQuery())
     keys = Keys.of(*(set(read_tokens) | set(appends)))
     return Txn(
         TxnKind.WRITE if appends else TxnKind.READ, keys,
@@ -446,6 +455,7 @@ class TcpHost:
 
     def _client_submit(self, from_id: int, body: dict) -> None:
         req = body.get("req")
+        want_phases = bool(body.get("phases"))
 
         def done(value, failure):
             from accord_tpu.pipeline.backpressure import Rejected
@@ -460,12 +470,25 @@ class TcpHost:
             if isinstance(failure, Rejected):
                 # typed load-shed: never coordinated, safe to retry
                 reply["shed"] = True
+            if want_phases and failure is None and value is not None \
+                    and getattr(value, "txn_id", None) is not None:
+                # per-phase SLO attribution for the open-loop harness
+                # (workload/openloop.py): the coordinator's span milestone
+                # firsts ride back on the reply — timestamps are this
+                # node's clock (time.time()-us, same machine as the
+                # harness), so the client can join them against its
+                # intended-start ledger without a second round trip
+                from accord_tpu.obs.spans import phase_firsts, trace_key
+                span = self.node.obs.spans.get(trace_key(value.txn_id))
+                reply["phases"] = [[ph, at]
+                                   for ph, at in phase_firsts(span)]
             self.emit(from_id, reply)
 
         try:
             read_tokens = body.get("reads", [])
             appends = {int(t): v for t, v in body.get("appends", {}).items()}
-            txn = _build_list_txn(read_tokens, appends)
+            txn = _build_list_txn(read_tokens, appends,
+                                  ephemeral=body.get("kind") == "ephemeral")
             self._coordinate(txn).add_callback(done)
         except BaseException as e:  # noqa: BLE001
             done(None, e)
@@ -586,9 +609,15 @@ class TcpClusterClient:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         _send_frame(sock, {"src": 0, "body": body})
 
-    def submit(self, to: int, reads, appends: Dict[int, int], req) -> None:
-        self._send(to, {"type": "submit", "req": req, "reads": list(reads),
-                        "appends": {str(k): v for k, v in appends.items()}})
+    def submit(self, to: int, reads, appends: Dict[int, int], req,
+               ephemeral: bool = False, want_phases: bool = False) -> None:
+        body = {"type": "submit", "req": req, "reads": list(reads),
+                "appends": {str(k): v for k, v in appends.items()}}
+        if ephemeral:
+            body["kind"] = "ephemeral"
+        if want_phases:
+            body["phases"] = True
+        self._send(to, body)
 
     def recv(self, timeout_s: float = 30.0) -> Optional[dict]:
         try:

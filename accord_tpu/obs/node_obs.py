@@ -13,19 +13,16 @@ from typing import Callable, Optional
 
 from accord_tpu.obs.flight import FlightRecorder
 from accord_tpu.obs.registry import Registry
-from accord_tpu.obs.spans import SpanStore, trace_key
-
-# protocol milestones in coordination order; the per-phase latency
-# breakdown is the delta between consecutive *present* milestones
-PHASE_ORDER = ("begin", "preaccept", "preaccept_extend", "begin_recover",
-               "accept", "commit", "stable", "apply", "end")
+from accord_tpu.obs.spans import (PHASE_ORDER, SpanStore, phase_deltas,
+                                  phase_firsts, trace_key)
 
 # milestones that each open one RPC round (fan-out + quorum wait): their
 # per-txn count is the round-count histogram the ROADMAP Infer A/B
 # harness prices against
 ROUND_PHASES = frozenset({"preaccept", "preaccept_extend", "accept",
                           "commit", "stable", "apply", "begin_recover",
-                          "get_deps", "await_commit", "invalidate"})
+                          "get_deps", "await_commit", "invalidate",
+                          "eph_deps", "eph_read"})
 
 
 class NodeObs:
@@ -107,14 +104,9 @@ class NodeObs:
     def _observe_phase_latencies(self, span) -> None:
         """Delta between consecutive present milestones -> per-phase
         latency histograms (first occurrence of each milestone)."""
-        firsts = []
-        for ph in PHASE_ORDER:
-            ev = span.first(ph)
-            if ev is not None:
-                firsts.append((ph, ev[0]))
-        for (ph, at), (_nph, nat) in zip(firsts, firsts[1:]):
+        for ph, dur in phase_deltas(phase_firsts(span)):
             self.registry.histogram("accord_phase_latency_us", phase=ph) \
-                .observe(max(0, nat - at))
+                .observe(dur)
 
     # -------------------------------------------------------- replica side --
     def rx(self, trace_id: str, verb: str, from_id: int) -> None:

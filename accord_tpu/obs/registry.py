@@ -75,7 +75,14 @@ class Histogram:
     """Log2-bucketed histogram: observe(v) lands in bucket ceil(log2(v)),
     i.e. bucket e counts 2**(e-1) < v <= 2**e (e=0 holds v <= 1).  One dict
     op per observation; quantiles are bucket-upper-bound approximations,
-    which is all a latency breakdown needs."""
+    which is all a latency breakdown needs.
+
+    ERROR BOUND (pinned by tests/test_obs.py): for any distribution and
+    any q, the reported quantile r and the exact same-rank sample value v
+    satisfy v <= r < 2*v (for v >= 1) — r is the upper bound of v's
+    bucket.  Monitoring tolerates a [1x, 2x) one-sided bound; a tail-
+    latency GATE does not, which is why every SLO lane and the profiler
+    use raw-sample exact quantiles (obs/report.exact_quantiles_us)."""
 
     __slots__ = ("name", "labels", "count", "sum", "buckets")
 

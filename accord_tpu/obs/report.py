@@ -1,14 +1,21 @@
-"""Cross-node snapshot merging + the human/bench summary.
+"""Cross-node snapshot merging + the human/bench summary + SLO reports.
 
 `summarize` turns a (possibly merged) registry snapshot into the compact
 report the bench records next to its BENCH_HISTORY row and the burn prints
 at end of run: fast-path ratio, coordination outcomes, per-phase latency
 quantiles, device flush-window counts, pipeline admission counters.
+
+`slo_report` builds the open-loop workload harness's SLO row
+(accord_tpu/workload/): exact-sample p50/p99/p99.9 — NEVER the registry's
+log2-bucket quantiles, whose up-to-2x error would false-trip a 15% tail
+gate (the PR-3 precedent that gave the profiler its raw-sample buffer) —
+for open-loop (intended-start) and closed-loop (submit-start) latency,
+per-phase attribution, and achieved-vs-offered rate.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from accord_tpu.obs.registry import (merge_snapshots, parse_labels,
                                      snapshot_quantile)
@@ -88,6 +95,71 @@ def _infer_section(metrics: dict) -> dict:
     return kinds
 
 
+# ------------------------------------------------------------- SLO rows ----
+
+SLO_QUANTILES = ((0.50, "p50_us"), (0.99, "p99_us"), (0.999, "p999_us"))
+
+
+def exact_quantiles_us(samples) -> dict:
+    """Exact nearest-rank quantiles from raw microsecond samples.  The
+    quantile path every SLO lane uses (quantile_source=exact-sample): the
+    log2-bucket histograms stay for always-on monitoring, but a tail GATE
+    needs sample-exact numbers (tests/test_obs.py pins the bucket path's
+    error bound at [1x, 2x) — far above a 15% threshold)."""
+    s = sorted(samples)
+    n = len(s)
+    if n == 0:
+        return {"count": 0}
+    out = {"count": n,
+           "mean_us": round(sum(s) / n, 1),
+           "max_us": int(s[-1])}
+    for q, name in SLO_QUANTILES:
+        rank = max(1, min(n, int(q * n + 0.9999999)))
+        out[name] = int(s[rank - 1])
+    return out
+
+
+def slo_report(open_samples_us, closed_samples_us,
+               phase_samples_us: Dict[str, list],
+               counts: Dict[str, int], offered_per_s: float,
+               duration_s: float, schedule: Optional[dict] = None,
+               summary: Optional[dict] = None) -> dict:
+    """The SLO row an open-loop lane records into BENCH_HISTORY (and
+    `bench.py --guard` gates): open-loop latency is measured from each
+    op's INTENDED start, so coordinator stalls are charged to the tail
+    instead of silently pausing the load (coordinated omission);
+    closed-loop is the same acked ops measured from actual submit — the
+    two diverge exactly by the omitted time.
+
+    phase_samples_us: per-phase exact samples from joining the intended-
+    start ledger against the PR-2 trace spans (obs/spans.phase_deltas),
+    plus the synthetic "admission" phase (begin - intended: scheduling +
+    pipeline queueing + any stall ahead of the coordinator)."""
+    submitted = sum(counts.get(k, 0)
+                    for k in ("acked", "shed", "failed", "pending"))
+    acked = counts.get("acked", 0)
+    report = {
+        "quantile_source": "exact-sample",
+        "schedule": schedule or {},
+        "offered_per_s": round(offered_per_s, 1),
+        "achieved_per_s": (round(acked / duration_s, 1)
+                           if duration_s > 0 else 0.0),
+        "duration_s": round(duration_s, 3),
+        "counts": dict(counts),
+        "shed_rate": (round(counts.get("shed", 0) / submitted, 4)
+                      if submitted else 0.0),
+        "open_loop": exact_quantiles_us(open_samples_us),
+        "closed_loop": exact_quantiles_us(closed_samples_us),
+        "phases": {ph: exact_quantiles_us(samples)
+                   for ph, samples in sorted(phase_samples_us.items())
+                   if samples},
+    }
+    if summary is not None:
+        report["fast_path_ratio"] = summary.get("fast_path_ratio")
+        report["recoveries"] = summary.get("recoveries", 0)
+    return report
+
+
 def summarize(metrics: dict) -> dict:
     paths = _counter_by_label(metrics, "accord_path_total", "path")
     fast = paths.get("fast", 0)
@@ -134,6 +206,10 @@ def summarize(metrics: dict) -> dict:
                                          "accord_pipeline_dispatched_total"),
             "batch_size_max": _gauge_max(metrics,
                                          "accord_pipeline_batch_size_max"),
+            # admission->dispatch wait (per-txn mean per batch): the
+            # pipeline's contribution to the SLO lanes' "admission" phase
+            "queue_wait_us": _hist_report(_merged_hist(
+                metrics, "accord_pipeline_queue_wait_us")),
         },
         "infer": _infer_section(metrics),
         "journal": {

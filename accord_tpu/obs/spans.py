@@ -24,6 +24,35 @@ from typing import Dict, List, Optional, Tuple
 
 _MAX_EVENTS_PER_SPAN = 256
 
+# protocol milestones in coordination order (the ephemeral-read path's two
+# rounds slot in right after begin — a span carries either the eph_* pair
+# or the witnessed-txn ladder, never both); the per-phase latency breakdown
+# is the delta between consecutive *present* milestones
+PHASE_ORDER = ("begin", "eph_deps", "eph_read", "preaccept",
+               "preaccept_extend", "begin_recover", "accept", "commit",
+               "stable", "apply", "end")
+
+
+def phase_firsts(span) -> list:
+    """[(phase, at_us)] — first occurrence of each PHASE_ORDER milestone
+    present on the span, in coordination order.  The join key between the
+    open-loop generator's intended-start ledger and a txn's trace."""
+    if span is None:
+        return []
+    out = []
+    for ph in PHASE_ORDER:
+        ev = span.first(ph)
+        if ev is not None:
+            out.append((ph, ev[0]))
+    return out
+
+
+def phase_deltas(firsts) -> list:
+    """[(phase, duration_us)] between consecutive present milestones of a
+    `phase_firsts` list: the time attributed to each phase."""
+    return [(ph, max(0, nat - at))
+            for (ph, at), (_nph, nat) in zip(firsts, firsts[1:])]
+
 
 def trace_key(txn_id) -> str:
     """Canonical trace id for a transaction (identical on every replica)."""

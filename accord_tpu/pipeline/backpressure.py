@@ -68,6 +68,10 @@ class PipelineStats:
                                       **labels)
         self._h_batch_size = registry.histogram(
             "accord_pipeline_batch_size", **labels)
+        # per-txn queue wait (admission -> dispatch): the pipeline's slice
+        # of the open-loop SLO lanes' "admission" phase — surfaced as
+        # pipeline.queue_wait_us in obs/report.summarize (burn --metrics,
+        # bench rows)
         self._h_queue_wait = registry.histogram(
             "accord_pipeline_queue_wait_us", **labels)
         self._queue_wait_us_sum = 0   # admission -> dispatch

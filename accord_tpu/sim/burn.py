@@ -80,7 +80,8 @@ class BurnRun:
                  pipeline_config=None,
                  restarts: int = 0,
                  journal_dir: Optional[str] = None,
-                 restart_down_s: float = 2.0):
+                 restart_down_s: float = 2.0,
+                 eph_ratio: float = 0.0):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -122,6 +123,11 @@ class BurnRun:
         self.concurrency = concurrency
         self.range_reads = range_reads
         self.range_every = range_every
+        # read-heavy ephemeral lane (ISSUE 6): this fraction of ops become
+        # single-key Zipf reads on the EPHEMERAL_READ path, putting the
+        # never-witnessed single-round read under the full nemesis stack
+        # (the default mix only reaches it via occasional 1-key pure reads)
+        self.eph_ratio = eph_ratio
         if durability:
             # randomized cadence like the reference burn (Cluster.java:333)
             cycle = (durability_cycle_s if durability_cycle_s is not None
@@ -166,6 +172,10 @@ class BurnRun:
     # ---------------------------------------------------------- workload --
     def _gen_txn(self) -> Txn:
         rng = self.rng
+        if self.eph_ratio and rng.next_float() < self.eph_ratio:
+            token = rng.next_zipf(self.keys)
+            return Txn(TxnKind.EPHEMERAL_READ, Keys.of(token),
+                       read=ListRead(Keys.of(token)), query=ListQuery())
         # ~1 in range_every ops: a range read over a token window (the
         # reference burn mixes range queries in, BurnTest.java:124-210)
         if self.range_reads and rng.next_int(0, self.range_every) == 0:
@@ -514,6 +524,9 @@ def main(argv=None) -> int:
                              "ingest pipeline (accord_tpu/pipeline/)")
     parser.add_argument("--range-heavy", action="store_true",
                         help="range reads ~1 in 3 ops instead of 1 in 8")
+    parser.add_argument("--eph-heavy", action="store_true",
+                        help="~half of ops become single-key reads on the "
+                             "ephemeral (never-witnessed) read path")
     parser.add_argument("--message-stats", action="store_true",
                         help="print per-message-type delivery/drop counters")
     parser.add_argument("--trace", action="store_true",
@@ -581,7 +594,8 @@ def main(argv=None) -> int:
                       partitions=args.partitions, clock_drift=args.drift,
                       trace=args.trace, pipeline=args.pipeline,
                       restarts=args.restart, journal_dir=journal_dir,
-                      restart_down_s=args.down)
+                      restart_down_s=args.down,
+                      eph_ratio=0.5 if args.eph_heavy else 0.0)
         stats = run.run()
         if args.trace:
             for node in run.cluster.nodes.values():

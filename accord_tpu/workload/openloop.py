@@ -1,0 +1,284 @@
+"""Open-loop drivers: intended-start scheduling over the sim and TCP hosts.
+
+Both runners share the measurement discipline that closed-loop bench lanes
+cannot provide:
+
+  * arrivals follow a pre-computed schedule (arrival.py) — completions
+    never gate submissions, so a stalled coordinator backs work up instead
+    of silently pausing the load;
+  * every op's latency is measured from its INTENDED start (the schedule
+    time), charging omitted time to the tail; the same acked ops measured
+    from actual submit give the closed-loop comparison — the delta IS the
+    coordinated omission;
+  * acked ops join the PR-2 trace spans (obs/spans.phase_firsts) for
+    per-phase attribution, plus a synthetic "admission" phase
+    (coordination begin - intended start: client scheduling, any stall
+    ahead of the coordinator, and pipeline queueing).
+
+The sim runner (`run_open_loop_sim`) is fully deterministic — virtual-time
+arrivals on the shared PendingQueue — and supports stall injection: during
+[stall_at_us, stall_at_us+stall_us) submissions are HELD AT THE
+COORDINATOR'S DOOR and released when the stall ends, the externally
+observable behavior of a wedged event loop (a client cannot observe which
+internal stage stalled, only that its op sat).  The TCP runner drives the
+real multi-process cluster on the wall clock; per-phase data rides back on
+submit replies (`want_phases`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from accord_tpu.utils.random_source import RandomSource
+from accord_tpu.workload.arrival import make_offsets_us
+from accord_tpu.workload.profiles import build_txn, make_profile
+
+# bounded exact-sample buffers: enough for sample-exact p99.9 at every
+# realistic lane size, bounded against a runaway caller
+MAX_SAMPLES = 1 << 17
+
+
+class OpRecord:
+    """One op's ledger row: intended vs actual submit vs end."""
+
+    __slots__ = ("idx", "intended_us", "submit_us", "end_us", "outcome",
+                 "phase_firsts")
+
+    def __init__(self, idx: int, intended_us: int):
+        self.idx = idx
+        self.intended_us = intended_us
+        self.submit_us: Optional[int] = None
+        self.end_us: Optional[int] = None
+        self.outcome: Optional[str] = None  # ack | shed | fail | None
+        self.phase_firsts: Optional[list] = None  # [(phase, at_us)]
+
+
+class OpenLoopResult:
+    """Ledger + SLO report of one open-loop run."""
+
+    def __init__(self, records: List[OpRecord], report: dict,
+                 summary: Optional[dict], schedule: dict):
+        self.records = records
+        self.report = report
+        self.summary = summary
+        self.schedule = schedule
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        return self.report["counts"]
+
+
+def _collect(records: List[OpRecord], offered_per_s: float,
+             schedule: dict, summary: Optional[dict],
+             t0_us: int) -> dict:
+    """Fold the ledger into the SLO report (obs/report.slo_report)."""
+    from accord_tpu.obs.report import slo_report
+    from accord_tpu.obs.spans import phase_deltas
+
+    open_lat: List[int] = []
+    closed_lat: List[int] = []
+    phases: Dict[str, List[int]] = {}
+    counts = {"acked": 0, "shed": 0, "failed": 0, "pending": 0}
+    last_end = t0_us
+    for rec in records:
+        if rec.outcome == "ack":
+            counts["acked"] += 1
+            last_end = max(last_end, rec.end_us)
+            if len(open_lat) < MAX_SAMPLES:
+                open_lat.append(max(0, rec.end_us - rec.intended_us))
+                closed_lat.append(max(0, rec.end_us - rec.submit_us))
+            firsts = rec.phase_firsts or []
+            if firsts:
+                # admission: intended start -> coordination begin (client
+                # scheduling + stall + pipeline queue), then the span's
+                # own milestone deltas
+                begin_at = firsts[0][1]
+                phases.setdefault("admission", []).append(
+                    max(0, begin_at - rec.intended_us))
+                for ph, dur in phase_deltas(firsts):
+                    if ph != "end":
+                        phases.setdefault(ph, []).append(dur)
+        elif rec.outcome == "shed":
+            counts["shed"] += 1
+        elif rec.outcome == "fail":
+            counts["failed"] += 1
+        else:
+            counts["pending"] += 1
+    duration_s = max(1e-9, (last_end - t0_us) / 1e6)
+    return slo_report(open_lat, closed_lat, phases, counts, offered_per_s,
+                      duration_s, schedule=schedule, summary=summary)
+
+
+# ------------------------------------------------------------- sim host ----
+
+def run_open_loop_sim(profile: str = "zipfian", ops: int = 400,
+                      rate_per_s: float = 400.0, schedule: str = "poisson",
+                      seed: int = 0, nodes: int = 3, keys: int = 48,
+                      n_shards: int = 4, pipeline: bool = True,
+                      stall_at_us: Optional[int] = None, stall_us: int = 0,
+                      store_factory: Optional[Callable] = None,
+                      profile_kwargs: Optional[dict] = None,
+                      keep_cluster: bool = False) -> OpenLoopResult:
+    """Deterministic open-loop run through the pipeline host in the sim:
+    arrivals at virtual-time offsets, latencies in virtual microseconds.
+
+    stall_at_us/stall_us: hold every submission landing inside the window
+    until it closes (a stalled coordinator as the client observes one).
+    Open-loop latency charges the hold (intended start predates it);
+    closed-loop latency of the SAME run does not — the coordinated-
+    omission demonstration (tests/test_workload.py)."""
+    from accord_tpu.sim.cluster import SimCluster
+
+    rng = RandomSource(seed)
+    cluster = SimCluster(n_nodes=nodes, seed=rng.next_long(),
+                         n_shards=n_shards, pipeline=pipeline,
+                         store_factory=store_factory)
+    cluster.start_durability_scheduling(shard_cycle_s=10.0)
+    prof = make_profile(profile, keys=keys, seed=rng.next_long(),
+                        **(profile_kwargs or {}))
+    offsets = make_offsets_us(schedule, rate_per_s, ops,
+                              seed=rng.next_long())
+    origin_rng = rng.fork()
+    t0_us = cluster.queue.clock.now_us
+    records = [OpRecord(i, t0_us + off) for i, off in enumerate(offsets)]
+    ops_list = [prof.next_op() for _ in range(ops)]
+    settled = [0]
+    stall_end_us = (t0_us + stall_at_us + stall_us
+                    if stall_at_us is not None and stall_us > 0 else None)
+    stall_begin_us = (t0_us + stall_at_us
+                      if stall_end_us is not None else None)
+
+    def submit(i: int) -> None:
+        now = cluster.queue.clock.now_us
+        if stall_end_us is not None and stall_begin_us <= now < stall_end_us:
+            # coordinator wedged: the op sits until the stall clears
+            cluster.queue.add(stall_end_us - now, lambda: submit(i))
+            return
+        rec = records[i]
+        rec.submit_us = now
+        origin = origin_rng.pick(cluster.live_node_ids())
+        txn = build_txn(ops_list[i])
+
+        def done(value, failure):
+            from accord_tpu.pipeline.backpressure import Rejected
+            rec.end_us = cluster.queue.clock.now_us
+            settled[0] += 1
+            if isinstance(failure, Rejected):
+                rec.outcome = "shed"
+            elif failure is not None:
+                rec.outcome = "fail"
+            elif value is not None:
+                rec.outcome = "ack"
+                from accord_tpu.obs.spans import phase_firsts, trace_key
+                span = cluster.nodes[origin].obs.spans.get(
+                    trace_key(value.txn_id))
+                rec.phase_firsts = phase_firsts(span)
+            else:
+                rec.outcome = "fail"
+
+        cluster.pipeline_submit(origin, txn).add_callback(done)
+
+    for i, off in enumerate(offsets):
+        cluster.queue.add(off, (lambda j: (lambda: submit(j)))(i))
+    cluster.process_until(lambda: settled[0] >= ops, max_items=50_000_000)
+
+    summary = cluster.metrics_snapshot()["summary"]
+    sched = {"kind": schedule, "rate_per_s": rate_per_s, "ops": ops,
+             "seed": seed, "host": "sim-pipeline" if pipeline else "sim"}
+    if stall_end_us is not None:
+        sched["stall_at_us"] = stall_at_us
+        sched["stall_us"] = stall_us
+    result = OpenLoopResult(records,
+                            _collect(records, rate_per_s, sched, summary,
+                                     t0_us),
+                            summary, sched)
+    if keep_cluster:
+        result.cluster = cluster
+    return result
+
+
+# ------------------------------------------------------------- tcp host ----
+
+def run_open_loop_tcp(profile: str = "zipfian", ops: int = 300,
+                      rate_per_s: float = 100.0, schedule: str = "poisson",
+                      seed: int = 7, nodes: int = 3, keys: int = 64,
+                      n_shards: int = 4, want_phases: bool = True,
+                      profile_kwargs: Optional[dict] = None,
+                      settle_timeout_s: float = 60.0) -> OpenLoopResult:
+    """Open-loop run over the REAL multi-process TCP cluster (wall clock).
+    ACCORD_PIPELINE et al. are read by the node processes from the ambient
+    environment — the caller chooses the host configuration.  Range ops are
+    sim-only (the submit frame carries no range encoding)."""
+    from accord_tpu.host.tcp import TcpClusterClient
+
+    rng = RandomSource(seed)
+    prof = make_profile(profile, keys=keys, seed=rng.next_long(),
+                        **(profile_kwargs or {}))
+    offsets = make_offsets_us(schedule, rate_per_s, ops,
+                              seed=rng.next_long())
+    ops_list = [prof.next_op() for _ in range(ops)]
+    assert all(op.ranges is None for op in ops_list), \
+        "range ops are sim-only (no wire encoding on the submit frame)"
+    origin_rng = rng.fork()
+    origins = [1 + origin_rng.next_int(nodes) for _ in range(ops)]
+
+    client = TcpClusterClient(n_nodes=nodes, n_shards=n_shards)
+    summary = None
+    try:
+        t0_us = int(time.time() * 1e6)
+        records = [OpRecord(i, t0_us + off) for i, off in enumerate(offsets)]
+
+        def handle(frame) -> bool:
+            body = frame.get("body", {})
+            if body.get("type") != "submit_reply":
+                return False
+            rec = records[body["req"]]
+            rec.end_us = int(time.time() * 1e6)
+            if body.get("ok"):
+                rec.outcome = "ack"
+                if body.get("phases"):
+                    rec.phase_firsts = [(ph, at) for ph, at
+                                        in body["phases"]]
+            elif body.get("shed"):
+                rec.outcome = "shed"
+            else:
+                rec.outcome = "fail"
+            return True
+
+        sent = pending = 0
+        while sent < ops:
+            due_us = records[sent].intended_us
+            now_us = int(time.time() * 1e6)
+            if now_us < due_us:
+                frame = client.recv(min(0.05, (due_us - now_us) / 1e6))
+                if frame is not None and handle(frame):
+                    pending -= 1
+                continue
+            op = ops_list[sent]
+            records[sent].submit_us = int(time.time() * 1e6)
+            client.submit(origins[sent], op.reads, op.appends, sent,
+                          ephemeral=op.ephemeral, want_phases=want_phases)
+            sent += 1
+            pending += 1
+        deadline = time.monotonic() + settle_timeout_s
+        while pending > 0 and time.monotonic() < deadline:
+            frame = client.recv(1.0)
+            if frame is not None and handle(frame):
+                pending -= 1
+
+        # obs snapshots AFTER the channel quiesces (fetch_metrics drops
+        # stray frames); merged summary feeds fast_path_ratio into the row
+        from accord_tpu.obs.report import merge_node_snapshots
+        snaps = [client.fetch_metrics(i) for i in range(1, nodes + 1)]
+        merged = merge_node_snapshots([s for s in snaps if s])
+        summary = merged["summary"] if merged["nodes"] else None
+    finally:
+        client.close()
+
+    sched = {"kind": schedule, "rate_per_s": rate_per_s, "ops": ops,
+             "seed": seed, "host": "tcp"}
+    return OpenLoopResult(records,
+                          _collect(records, rate_per_s, sched, summary,
+                                   t0_us),
+                          summary, sched)

@@ -99,6 +99,11 @@ def _regression_guard(result: dict) -> None:
             # per-kernel p50/p99 + retrace summary (obs/profiler.py):
             # what `--guard` diffs against the last clean baseline
             entry["profile"] = result["profile"]
+        if "slo" in result:
+            # open-loop SLO report (workload/openloop.py): exact-sample
+            # p50/p99/p99.9 overall and per phase — `--guard` gates the
+            # tails, not just the headline throughput
+            entry["slo"] = result["slo"]
         lane = history.setdefault(CONFIG, {})
         old = lane.get(pclass)
         if old is not None:
@@ -1278,9 +1283,125 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
     }))
 
 
+# ------------------------------------------------------------------ slo ----
+
+# open-loop SLO lanes (workload/openloop.py): named profiles driven through
+# the pipeline host at a seeded arrival schedule, latency measured from
+# INTENDED start (coordinated omission charged, not hidden).  Offered rates
+# sit below the sim cluster's saturation point so the lanes measure tail
+# latency under load, not pure overload queueing.  All sim lanes are fully
+# deterministic (virtual time), so their guard gates only fire on real
+# behavioral regressions.
+SLO_SIM_LANES = {
+    "slo-zipf": dict(profile="zipfian", ops=600, rate_per_s=100.0, keys=48),
+    "slo-range": dict(profile="range_mix", ops=500, rate_per_s=80.0,
+                      keys=48),
+    "slo-tpcc": dict(profile="tpcc_neworder", ops=400, rate_per_s=60.0,
+                     keys=64),
+    "slo-ephemeral": dict(profile="ephemeral_read_heavy", ops=600,
+                          rate_per_s=150.0, keys=48),
+}
+
+
+def _slo_env_overrides(lane: dict) -> dict:
+    """ACCORD_SLO_OPS / ACCORD_SLO_RATE shrink a lane (guard tests);
+    ACCORD_SLO_STALL_US injects a synthetic coordinator stall at 40% of
+    the schedule span — a TAIL-ONLY regression (p99 up, throughput ~flat:
+    arrivals keep their schedule, so the run's duration barely moves)
+    that exercises the guard's tail gate end-to-end."""
+    lane = dict(lane)
+    if os.environ.get("ACCORD_SLO_OPS"):
+        lane["ops"] = int(os.environ["ACCORD_SLO_OPS"])
+    if os.environ.get("ACCORD_SLO_RATE"):
+        lane["rate_per_s"] = float(os.environ["ACCORD_SLO_RATE"])
+    stall_us = int(os.environ.get("ACCORD_SLO_STALL_US", "0") or 0)
+    if stall_us > 0:
+        span_us = lane["ops"] / lane["rate_per_s"] * 1e6
+        lane["stall_at_us"] = int(0.4 * span_us)
+        lane["stall_us"] = stall_us
+    return lane
+
+
+def bench_slo_sim(config: str, seed: int = 11):
+    """One sim SLO lane: open-loop generator -> pipeline host -> per-phase
+    SLO report recorded in the row and BENCH_HISTORY (`--guard` gates the
+    p99/p99.9 tails against the last clean baseline)."""
+    from accord_tpu.workload import run_open_loop_sim
+
+    lane = _slo_env_overrides(SLO_SIM_LANES[config])
+    profile = lane.pop("profile")
+    run = run_open_loop_sim(profile=profile, seed=seed,
+                            schedule=os.environ.get("ACCORD_SLO_SCHEDULE",
+                                                    "poisson"),
+                            **lane)
+    rep = run.report
+    counts = rep["counts"]
+    assert counts["acked"] > 0.5 * lane["ops"], counts
+    assert counts["pending"] == 0, counts
+    emit({
+        "metric": config.replace("-", "_") + "_txn_per_sec",
+        "value": rep["achieved_per_s"],
+        "unit": "txn/s",
+        "workload": f"open-loop {profile} via sim pipeline host "
+                    f"({rep['schedule']['kind']} arrivals)",
+        "ops": lane["ops"],
+        "acked": counts["acked"],
+        "shed": counts["shed"],
+        "offered_per_s": rep["offered_per_s"],
+        "open_p99_ms": round(rep["open_loop"]["p99_us"] / 1e3, 1),
+        "slo": rep,
+    })
+
+
+def bench_slo_tcp(config: str, profile: str, ops: int = 400,
+                  rate_per_s: float = 80.0, keys: int = 64, seed: int = 7):
+    """Open-loop SLO lane over the REAL multi-process TCP cluster with the
+    ingest pipeline on (ACCORD_PIPELINE=1 in every node process): wall-
+    clock arrivals, per-phase data joined from the submit replies.  The
+    `ephemeral` config is this lane on the ephemeral-read path — the path's
+    first bench coverage (ISSUE 6 satellite)."""
+    from accord_tpu.workload import run_open_loop_tcp
+
+    os.environ["ACCORD_PIPELINE"] = "1"
+    os.environ.setdefault("ACCORD_PIPELINE_MAX_BATCH", "8")
+    os.environ.setdefault("ACCORD_PIPELINE_MAX_WAIT_US", "2000")
+    if os.environ.get("ACCORD_SLO_OPS"):
+        ops = int(os.environ["ACCORD_SLO_OPS"])
+    if os.environ.get("ACCORD_SLO_RATE"):
+        rate_per_s = float(os.environ["ACCORD_SLO_RATE"])
+    run = run_open_loop_tcp(profile=profile, ops=ops,
+                            rate_per_s=rate_per_s, keys=keys, seed=seed)
+    rep = run.report
+    counts = rep["counts"]
+    assert counts["acked"] > 0.5 * ops, counts
+    if profile == "ephemeral_read_heavy":
+        # the lane must actually exercise the ephemeral path: its two
+        # rounds appear in the per-phase attribution
+        assert "eph_deps" in rep["phases"], sorted(rep["phases"])
+    emit({
+        "metric": config.replace("-", "_") + "_txn_per_sec",
+        "value": rep["achieved_per_s"],
+        "unit": "txn/s",
+        "workload": f"open-loop {profile} via TCP pipeline host "
+                    f"({rep['schedule']['kind']} arrivals)",
+        "nodes": 3,
+        "ops": ops,
+        "acked": counts["acked"],
+        "shed": counts["shed"],
+        "offered_per_s": rep["offered_per_s"],
+        "open_p99_ms": round(rep["open_loop"]["p99_us"] / 1e3, 1),
+        "slo": rep,
+    })
+
+
 # ---------------------------------------------------------------- guard ----
 
 GUARD_PCT = 15.0  # per-kernel (and headline) regression threshold, percent
+
+# tail gates need enough samples to be meaningful and an absolute floor so
+# microsecond-level noise on a wall-clock lane cannot trip a percentage
+SLO_GUARD_MIN_COUNT = 20
+SLO_GUARD_FLOOR_US = 500
 
 
 def _load_history() -> dict:
@@ -1315,7 +1436,66 @@ def _guard_problems(current: dict, baseline: dict) -> list:
             problems.append(
                 f"kernel {kernel}: p50 {b['p50']}us -> {c['p50']}us "
                 f"(+{(c['p50'] / b['p50'] - 1) * 100:.0f}%)")
+    problems.extend(_slo_problems(current, baseline))
     return problems
+
+
+def _slo_tail_check(what: str, b: dict, c: dict, quantiles,
+                    problems: list) -> None:
+    if min(b.get("count", 0), c.get("count", 0)) < SLO_GUARD_MIN_COUNT:
+        return
+    for q in quantiles:
+        bv, cv = b.get(q), c.get(q)
+        if not bv or not cv or bv < SLO_GUARD_FLOOR_US:
+            continue
+        if cv > bv * (1 + GUARD_PCT / 100.0):
+            problems.append(
+                f"slo {what} {q}: {bv}us -> {cv}us "
+                f"(+{(cv / bv - 1) * 100:.0f}%)")
+
+
+def _slo_problems(current: dict, baseline: dict) -> list:
+    """Tail-latency regressions of an SLO row vs its baseline: the open-
+    loop p99/p99.9 (the lane's reason to exist — intended-start latency
+    that charges coordinated omission) and every per-phase p99.  A tail-
+    only slowdown (p99 up, throughput flat) therefore fails the guard even
+    though the headline metric moved nothing."""
+    problems: list = []
+    cslo = current.get("slo") or {}
+    bslo = baseline.get("slo") or {}
+    if not cslo or not bslo:
+        return problems
+    _slo_tail_check("open_loop", bslo.get("open_loop") or {},
+                    cslo.get("open_loop") or {},
+                    ("p99_us", "p999_us"), problems)
+    bphases = bslo.get("phases") or {}
+    for ph, c in sorted((cslo.get("phases") or {}).items()):
+        b = bphases.get(ph)
+        if b:
+            _slo_tail_check(f"phase {ph}", b, c, ("p99_us",), problems)
+    return problems
+
+
+def _validate_slo_schema(slo: dict, where: str) -> None:
+    """The SLO row contract `--guard --dry-run` enforces on BENCH_HISTORY
+    (schema rot in a recorded lane must fail CI, not silently stop
+    gating).  Every quantile section must be the exact-sample path —
+    bucket quantiles false-trip a 15% gate (PR-3 precedent)."""
+    assert slo.get("quantile_source") == "exact-sample", \
+        f"{where}: slo rows must use exact-sample quantiles"
+    for sec in ("open_loop", "closed_loop"):
+        q = slo.get(sec)
+        assert isinstance(q, dict) and "count" in q, f"{where}: missing {sec}"
+        if q["count"]:
+            for k in ("p50_us", "p99_us", "p999_us", "mean_us", "max_us"):
+                assert k in q, f"{where}: {sec} missing {k}"
+    phases = slo.get("phases")
+    assert isinstance(phases, dict) and phases, f"{where}: missing phases"
+    for ph, q in phases.items():
+        assert "p99_us" in q and "count" in q, f"{where}: phase {ph}"
+    for k in ("offered_per_s", "achieved_per_s", "counts", "shed_rate",
+              "schedule"):
+        assert k in slo, f"{where}: missing {k}"
 
 
 def _guard_baseline(result: dict):
@@ -1385,12 +1565,18 @@ def run_guard_dry(config: str) -> int:
         probe["prev_same_platform"] = entry
         assert not _guard_problems(probe, entry), \
             f"self-diff of {config}/{pclass} reported a regression"
-        checked.append({
+        row = {
             "pclass": pclass, "value": entry.get("value"),
             "stale_superseded": len(lane.get("superseded", [])),
             "profile_kernels": sorted(
                 (entry.get("profile") or {}).get("kernels", {})),
-        })
+        }
+        if "slo" in entry:
+            # SLO-row schema validation: the tail gate reads these fields
+            _validate_slo_schema(entry["slo"], f"{config}/{pclass}")
+            row["slo_open_p99_us"] = entry["slo"]["open_loop"].get("p99_us")
+            row["slo_phases"] = sorted(entry["slo"]["phases"])
+        checked.append(row)
     print(json.dumps({"metric": f"{config}_guard", "dry_run": True,
                       "history": HISTORY_PATH, "baselines": checked}))
     return 0
@@ -1504,7 +1690,9 @@ def main():
     ap.add_argument("--config", default="default",
                     choices=["default", "zipf1m", "rangestress", "tpcc",
                              "maelstrom", "maelstrom-rw", "tcp",
-                             "pipeline", "scalar", "journal"])
+                             "pipeline", "scalar", "journal",
+                             "slo-zipf", "slo-range", "slo-tpcc",
+                             "slo-ephemeral", "slo-tcp", "ephemeral"])
     ap.add_argument("--guard", action="store_true",
                     help="after the run, diff the row (headline + per-"
                          "kernel profile p50s) against the last clean "
@@ -1545,7 +1733,9 @@ def main():
     if ns.dry_run:
         raise SystemExit(run_guard_dry(CONFIG))
     if ns.config not in ("maelstrom", "maelstrom-rw", "tcp", "pipeline",
-                         "scalar", "journal"):
+                         "scalar", "journal", "slo-zipf", "slo-range",
+                         "slo-tpcc", "slo-ephemeral", "slo-tcp",
+                         "ephemeral"):
         # device-using configs probe the (possibly dead-tunneled) backend
         # first; host-only configs never touch the chip
         from accord_tpu.utils.backend import resolve_platform
@@ -1568,6 +1758,13 @@ def main():
         bench_scalar()
     elif ns.config == "journal":
         bench_journal()
+    elif ns.config in SLO_SIM_LANES:
+        bench_slo_sim(ns.config)
+    elif ns.config == "slo-tcp":
+        bench_slo_tcp("slo-tcp", "zipfian", ops=400, rate_per_s=80.0)
+    elif ns.config == "ephemeral":
+        bench_slo_tcp("ephemeral", "ephemeral_read_heavy", ops=400,
+                      rate_per_s=100.0)
     else:
         bench_rangestress()
     if ns.guard:

@@ -62,3 +62,63 @@ def test_guard_exits_nonzero_on_synthetic_2x_kernel_slowdown(tmp_path):
     ok = _run(["--config", "scalar", "--guard"],
               {"ACCORD_BENCH_HISTORY": hist, "ACCORD_PROFILE_SCALE": "0.5"})
     assert "kernel scalar_scan" not in ok.stderr, ok.stderr
+
+
+# -------------------------------------------------- SLO tail gate (ISSUE 6) --
+
+def test_slo_guard_dry_run_validates_slo_row_schema():
+    """The checked-in BENCH_HISTORY.json SLO rows must stay guard-
+    parseable AND schema-valid (exact-sample quantile sections, phases,
+    offered/achieved rates) — schema rot must fail CI, not silently stop
+    the tail gate."""
+    proc = _run(["--config", "slo-zipf", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "slo-zipf_guard" and row["dry_run"] is True
+    assert row["baselines"], "no slo-zipf baseline in BENCH_HISTORY.json"
+    base = row["baselines"][0]
+    assert base["slo_open_p99_us"] > 0
+    assert "preaccept" in base["slo_phases"]
+    assert "admission" in base["slo_phases"]
+
+
+def test_slo_guard_dry_run_rejects_bucket_quantile_rows(tmp_path):
+    """A history row claiming anything but the exact-sample quantile path
+    must fail the dry run (PR-3 precedent: bucket quantiles false-trip a
+    15%% gate)."""
+    hist = tmp_path / "hist.json"
+    good = json.load(open(os.path.join(REPO, "BENCH_HISTORY.json")))
+    lane = json.loads(json.dumps(good["slo-zipf"]))  # deep copy
+    lane["host"]["slo"]["quantile_source"] = "log2-bucket"
+    hist.write_text(json.dumps({"slo-zipf": lane}))
+    proc = _run(["--config", "slo-zipf", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "exact-sample" in (proc.stderr + proc.stdout)
+
+
+def test_slo_guard_exits_nonzero_on_tail_only_regression(tmp_path):
+    """ISSUE 6 acceptance: a synthetic TAIL-ONLY slowdown — a coordinator
+    stall injected into the open-loop generator (ACCORD_SLO_STALL_US),
+    p99 up several-fold while throughput stays inside the headline gate —
+    must exit nonzero, retire the failed row, and restore the baseline."""
+    hist = str(tmp_path / "hist.json")
+    env = {"ACCORD_BENCH_HISTORY": hist,
+           "ACCORD_SLO_OPS": "200", "ACCORD_SLO_RATE": "60"}
+    first = _run(["--config", "slo-zipf", "--guard"], env, timeout=420)
+    assert first.returncode == 0, first.stderr
+    assert "no clean baseline" in first.stderr
+    baseline_p99 = json.load(open(hist))["slo-zipf"]["host"]["slo"][
+        "open_loop"]["p99_us"]
+    slow = _run(["--config", "slo-zipf", "--guard"],
+                dict(env, ACCORD_SLO_STALL_US="400000"), timeout=420)
+    assert slow.returncode != 0, (slow.stdout, slow.stderr)
+    assert "slo open_loop p99_us" in slow.stderr
+    # tail-ONLY: the headline throughput did not trip the gate
+    assert "headline" not in slow.stderr
+    # failed row retired (stale + guard_failed), clean baseline restored
+    lane = json.load(open(hist))["slo-zipf"]
+    assert "guard_failed" not in lane["host"]
+    assert lane["host"]["slo"]["open_loop"]["p99_us"] == baseline_p99
+    assert any(e.get("guard_failed") and e.get("stale")
+               for e in lane["superseded"])

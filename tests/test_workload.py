@@ -1,0 +1,147 @@
+"""Open-loop SLO workload harness (ISSUE 6, accord_tpu/workload/).
+
+The acceptance-critical property lives here: latency measured from
+INTENDED start charges coordinated omission — an injected coordinator
+stall demonstrably moves the open-loop p99 while a closed-loop measurement
+of the very same run barely moves (it only starts its clock when the
+coordinator finally accepted the op).
+"""
+
+import pytest
+
+from accord_tpu.workload.arrival import (make_offsets_us, paced_offsets_us,
+                                         poisson_offsets_us)
+from accord_tpu.workload.openloop import run_open_loop_sim
+from accord_tpu.workload.profiles import (PROFILES, build_txn, make_profile)
+
+
+# ---------------------------------------------------------------- arrival --
+
+def test_arrival_schedules_deterministic_and_at_rate():
+    a = poisson_offsets_us(200.0, 500, seed=9)
+    b = poisson_offsets_us(200.0, 500, seed=9)
+    assert a == b, "schedule must be reproducible from its seed"
+    assert a != poisson_offsets_us(200.0, 500, seed=10)
+    assert all(x <= y for x, y in zip(a, a[1:]))
+    # 500 ops at 200/s spans ~2.5s; Poisson jitter stays well inside 2x
+    assert 1.2e6 < a[-1] < 5.0e6
+    p = paced_offsets_us(100.0, 10)
+    assert p == [i * 10_000 for i in range(10)]
+    with pytest.raises(ValueError):
+        make_offsets_us("bursty", 100.0, 10)
+
+
+# --------------------------------------------------------------- profiles --
+
+def test_profiles_are_deterministic_and_shaped():
+    from accord_tpu.primitives.timestamp import TxnKind
+    for name in PROFILES:
+        pa, pb = (make_profile(name, keys=32, seed=4) for _ in range(2))
+        ops_a = [pa.next_op() for _ in range(50)]
+        ops_b = [pb.next_op() for _ in range(50)]
+        assert [repr(o) for o in ops_a] == [repr(o) for o in ops_b], name
+    eph_prof = make_profile("ephemeral_read_heavy", keys=32, seed=1)
+    eph = [eph_prof.next_op() for _ in range(100)]
+    n_eph = sum(1 for o in eph if o.ephemeral)
+    assert 60 <= n_eph <= 99, "lane must be ephemeral-read-heavy"
+    assert all(len(o.reads) == 1 and not o.appends
+               for o in eph if o.ephemeral)
+    assert build_txn(eph[0] if eph[0].ephemeral else
+                     next(o for o in eph if o.ephemeral)).kind \
+        == TxnKind.EPHEMERAL_READ
+    tpcc_prof = make_profile("tpcc_neworder", keys=64, seed=2)
+    tpcc = [tpcc_prof.next_op() for _ in range(30)]
+    assert all(len(op.appends) >= 2 for op in tpcc), \
+        "neworder writes district counter + stock keys"
+    assert all(max(op.appends) < 64 for op in tpcc)
+    rmix_prof = make_profile("range_mix", keys=32, seed=3)
+    rmix = [rmix_prof.next_op() for _ in range(60)]
+    assert any(op.ranges for op in rmix)
+    values = [v for op in tpcc for v in op.appends.values()]
+    assert len(values) == len(set(values)), "append values must be unique"
+
+
+# ------------------------------------------------------------- sim runner --
+
+def test_open_loop_sim_zipfian_slo_report():
+    run = run_open_loop_sim(profile="zipfian", ops=150, rate_per_s=150.0,
+                            seed=3, keys=32)
+    rep = run.report
+    assert rep["quantile_source"] == "exact-sample"
+    assert rep["counts"]["acked"] > 100
+    assert rep["counts"]["pending"] == 0
+    for sec in ("open_loop", "closed_loop"):
+        for k in ("p50_us", "p99_us", "p999_us", "count"):
+            assert k in rep[sec], (sec, k)
+    # the intended-start ledger joined the PR-2 trace spans: per-phase
+    # attribution covers admission + the protocol milestones
+    assert "admission" in rep["phases"]
+    assert "preaccept" in rep["phases"]
+    assert rep["phases"]["preaccept"]["count"] > 100
+    assert rep["fast_path_ratio"] is not None
+    assert rep["achieved_per_s"] > 0
+
+
+def test_open_loop_sim_is_deterministic():
+    a = run_open_loop_sim(profile="zipfian", ops=80, rate_per_s=200.0,
+                          seed=12, keys=24).report
+    b = run_open_loop_sim(profile="zipfian", ops=80, rate_per_s=200.0,
+                          seed=12, keys=24).report
+    assert a == b, "virtual-time lanes must be bit-identical per seed"
+
+
+def test_open_loop_ephemeral_path_end_to_end():
+    """The read-heavy ephemeral lane: EPHEMERAL_READ ops flow through the
+    pipeline host, get per-phase attribution for the path's two rounds,
+    and never become a Command anywhere (the path's defining invariant)."""
+    from accord_tpu.primitives.timestamp import TxnKind
+    run = run_open_loop_sim(profile="ephemeral_read_heavy", ops=150,
+                            rate_per_s=200.0, seed=6, keys=32,
+                            keep_cluster=True)
+    rep = run.report
+    assert rep["counts"]["acked"] > 100
+    assert rep["phases"]["eph_deps"]["count"] > 50
+    assert rep["phases"]["eph_read"]["count"] > 50
+    for node in run.cluster.nodes.values():
+        for store in node.command_stores.all():
+            for txn_id in store.commands:
+                assert txn_id.kind != TxnKind.EPHEMERAL_READ
+
+
+def test_coordinated_omission_captured_by_intended_start():
+    """ISSUE 6 satellite: a synthetic coordinator stall must move the
+    open-loop (intended-start) p99 while the closed-loop measurement of
+    the SAME run stays near the stall-free baseline — and throughput stays
+    flat, because open-loop arrivals never pause (that is exactly the
+    omission a closed-loop client coordinates away)."""
+    kw = dict(profile="zipfian", ops=200, rate_per_s=60.0, seed=5, keys=48)
+    clean = run_open_loop_sim(**kw).report
+    stall_us = 400_000
+    stalled = run_open_loop_sim(stall_at_us=500_000, stall_us=stall_us,
+                                **kw).report
+    open_p99 = stalled["open_loop"]["p99_us"]
+    closed_p99 = stalled["closed_loop"]["p99_us"]
+    # open-loop charges the stall ...
+    assert open_p99 >= 0.6 * stall_us, (open_p99, stall_us)
+    assert open_p99 > 2.0 * clean["open_loop"]["p99_us"]
+    # ... the closed-loop view of the same run hides it ...
+    assert closed_p99 < 0.5 * open_p99, (closed_p99, open_p99)
+    assert closed_p99 < 2.0 * clean["closed_loop"]["p99_us"]
+    # ... and the stall is tail-only: throughput within 10% of clean
+    assert abs(stalled["achieved_per_s"] - clean["achieved_per_s"]) \
+        < 0.1 * clean["achieved_per_s"]
+    # the held ops' omitted time lands in the admission phase
+    assert stalled["phases"]["admission"]["p99_us"] >= 0.5 * stall_us
+
+
+def test_tcp_wire_txn_builder_ephemeral():
+    """The TCP host's submit path can build the ephemeral txn (the wire
+    lane bench.py --config ephemeral drives); pure-read constraint
+    enforced."""
+    from accord_tpu.host.tcp import _build_list_txn
+    from accord_tpu.primitives.timestamp import TxnKind
+    txn = _build_list_txn([5], {}, ephemeral=True)
+    assert txn.kind == TxnKind.EPHEMERAL_READ
+    with pytest.raises(AssertionError):
+        _build_list_txn([5], {5: 1}, ephemeral=True)
+    assert _build_list_txn([5], {6: 1}).kind == TxnKind.WRITE
