@@ -137,6 +137,7 @@ class MaelstromHost:
         self.node = None
         self.pipeline = None  # built with the node when ACCORD_PIPELINE=1
         self.metrics_server = None  # built with the node (obs/httpd)
+        self.auditor = None         # built with the node (local/audit.py)
         self.node_name = ""
         self.names: Dict[int, str] = {}
         self.scheduler = RealTimeScheduler()
@@ -194,6 +195,12 @@ class MaelstromHost:
         from accord_tpu.obs.httpd import maybe_start_from_env
         self.metrics_server = maybe_start_from_env(lambda: self.node.obs,
                                                    node_id=my_id)
+        # ACCORD_AUDIT_S=<s>: periodic replica-state audit + census over
+        # the AUDIT_* verbs (local/audit.py; default on at 5 s, 0 off) —
+        # the audit traffic rides ordinary "accord" envelopes, the live
+        # view rides the metrics endpoint's /audit route
+        from accord_tpu.local.audit import auditor_from_env
+        self.auditor = auditor_from_env(self.node)
 
     # ------------------------------------------------------------ handlers --
     def handle(self, envelope: dict) -> None:

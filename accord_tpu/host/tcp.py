@@ -300,6 +300,14 @@ class TcpHost:
         self.metrics_server = maybe_start_from_env(lambda: self.node.obs,
                                                    node_id=my_id)
 
+        # ACCORD_AUDIT_S=<s>: periodic replica-state audit + lifecycle
+        # census (local/audit.py) — cross-replica range digests over the
+        # AUDIT_* verbs every <s> seconds, divergences and census served
+        # at the "audit" frame and the metrics endpoint's /audit route.
+        # Default on at 5 s; 0 disables.
+        from accord_tpu.local.audit import auditor_from_env
+        self.auditor = auditor_from_env(self.node)
+
         threading.Thread(target=self._accept_loop, daemon=True).start()
         self.loop_thread = threading.Thread(target=self._run, daemon=True)
         self.loop_thread.start()
@@ -437,6 +445,17 @@ class TcpHost:
                     "recorded_total": flight.recorded_total,
                     "events": [list(e) for e in events]})
             return
+        if kind == "audit":
+            # live replica-state audit view over the frame transport:
+            # divergences, the last digest-round report, and the census
+            # (same data the metrics endpoint serves at /audit)
+            if from_id <= 0:
+                view = (self.auditor.view() if self.auditor is not None
+                        else {})
+                self.emit(from_id, {"type": "audit_reply",
+                                    "req": body.get("req"),
+                                    "node": self.my_id, "audit": view})
+            return
         if kind == "stop":
             # accept stop only from harness/client frames (non-positive
             # declared src).  NOTE: src is self-declared — this guards
@@ -516,6 +535,8 @@ class TcpHost:
 
     def close(self) -> None:
         self.running = False
+        if self.auditor is not None:
+            self.auditor.stop()
         if self.wal is not None:
             try:
                 self.wal.close()  # final fsync: nothing acked is lost
@@ -665,6 +686,25 @@ class TcpClusterClient:
             body = got.get("body", {})
             if body.get("type") == "flight_reply" and body.get("req") == req:
                 return body
+        return None
+
+    def fetch_audit(self, to: int, timeout_s: float = 15.0
+                    ) -> Optional[dict]:
+        """Pull node `to`'s replica-state audit view over the frame
+        transport (same quiet-channel caveat as fetch_metrics)."""
+        req = f"audit-{to}"
+        try:
+            self._send(to, {"type": "audit", "req": req})
+        except OSError:
+            return None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = self.recv(min(1.0, timeout_s))
+            if got is None:
+                continue
+            body = got.get("body", {})
+            if body.get("type") == "audit_reply" and body.get("req") == req:
+                return body.get("audit")
         return None
 
     def close(self) -> None:

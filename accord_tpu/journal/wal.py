@@ -58,7 +58,8 @@ class JournalConfig:
     def __init__(self, directory: str, segment_bytes: int = 4 << 20,
                  fsync_window_us: int = 2000, max_batch: int = 256,
                  snapshot_segments: int = 4, fsync: bool = True,
-                 verify_compaction: bool = True):
+                 verify_compaction: bool = True, stall_us: int = 0,
+                 stall_after: int = 0):
         self.directory = directory
         self.segment_bytes = max(4096, segment_bytes)
         self.fsync_window_us = max(0, fsync_window_us)
@@ -68,6 +69,13 @@ class JournalConfig:
         self.snapshot_segments = snapshot_segments
         self.fsync = fsync
         self.verify_compaction = verify_compaction
+        # fsync-stall injection (the SLO harness's durability-tier arm):
+        # once `stall_after` appends have landed, the FLUSH THREAD sleeps
+        # `stall_us` exactly once before its next fsync — a stuck disk as
+        # the ack path observes it (durability-gated replies back up behind
+        # the stalled group commit; open-loop latency charges the stall)
+        self.stall_us = max(0, stall_us)
+        self.stall_after = max(0, stall_after)
 
     @property
     def group_commit(self) -> bool:
@@ -81,7 +89,9 @@ class JournalConfig:
             fsync_window_us=_env_int("ACCORD_JOURNAL_FSYNC_US", 2000),
             max_batch=_env_int("ACCORD_JOURNAL_MAX_BATCH", 256),
             snapshot_segments=_env_int("ACCORD_JOURNAL_SNAPSHOT_SEGMENTS",
-                                       4))
+                                       4),
+            stall_us=_env_int("ACCORD_JOURNAL_STALL_US", 0),
+            stall_after=_env_int("ACCORD_JOURNAL_STALL_AFTER", 0))
 
     def __repr__(self):
         return (f"JournalConfig({self.directory!r} "
@@ -126,7 +136,10 @@ class WriteAheadLog:
         self._c_fsync = registry.counter("accord_journal_fsync_total")
         self._c_rotate = registry.counter("accord_journal_rotations_total")
         self._c_snapshots = registry.counter("accord_journal_snapshots_total")
+        self._c_stalls = registry.counter("accord_journal_stall_total")
         self._h_batch = registry.histogram("accord_journal_group_commit_batch")
+        # one-shot fsync-stall injection armed by config (SLO stall arm)
+        self._stall_pending = self.config.stall_us > 0
         # retain=True keeps every appended request in memory so the sim's
         # journal validator can fold for_node() without re-reading disk;
         # hosts pass retain=False (they never fold, and must not grow
@@ -340,6 +353,15 @@ class WriteAheadLog:
                         break  # a full slice brought nothing new
                     last_depth = len(self._buffer)
                 batch, self._buffer = self._buffer, []
+            if self._stall_pending and batch \
+                    and batch[-1][0] >= cfg.stall_after:
+                # injected fsync stall (config.stall_us): the flush thread
+                # — not the coordinator door — wedges, so everything
+                # durability-gated behind this window queues up exactly as
+                # it would behind a stuck disk
+                self._stall_pending = False
+                self._c_stalls.inc()
+                time.sleep(cfg.stall_us / 1e6)
             self._write_batch([(seq, payload) for seq, payload, _ in batch])
             with self._lock:
                 self._mark_durable(batch[-1][0])

@@ -201,6 +201,40 @@ class RedundantBefore:
             result = self._map.fold_intersecting(r.start, r.end, fold, result)
         return result if result is not None else TXNID_NONE
 
+    def min_shard_applied_before(self, ranges: Ranges) -> TxnId:
+        """Floor of the shard-applied fence across `ranges` (census gauge;
+        uncovered spans floor to NONE like min_locally_applied_before)."""
+        def fold(acc, v):
+            w = v.shard_applied_before if v is not None else TXNID_NONE
+            return w if acc is None else min(acc, w)
+
+        result: Optional[TxnId] = None
+        for r in ranges:
+            result = self._map.fold_intersecting(r.start, r.end, fold, result)
+        return result if result is not None else TXNID_NONE
+
+    def audit_low_bound(self, ranges: Ranges) -> Timestamp:
+        """The replica-state auditor's LOW digest bound for this replica
+        over `ranges`: the max, over every intersecting span, of
+        bootstrapped_at and any staleness fence.  Below it this replica's
+        history may legitimately be a snapshot-shaped hole (bootstrap
+        installed data, not command metadata; a stale span is mid-reacquire)
+        — cross-replica digests must not cover it (local/audit.py)."""
+        bound: Timestamp = TXNID_NONE
+
+        def fold(acc, v):
+            if v is None:
+                return acc
+            m = v.bootstrapped_at
+            if v.stale_until_at_least is not None \
+                    and v.stale_until_at_least > m:
+                m = v.stale_until_at_least
+            return m if m > acc else acc
+
+        for r in ranges:
+            bound = self._map.fold_intersecting(r.start, r.end, fold, bound)
+        return bound
+
 
 class DurableBefore:
     """Range map -> {majority_before, universal_before} TxnId durability bounds

@@ -66,6 +66,12 @@ class MessageType(enum.Enum):
     QUERY_DURABLE_BEFORE_REQ = ("QUERY_DURABLE_BEFORE_REQ", False)
     QUERY_DURABLE_BEFORE_RSP = ("QUERY_DURABLE_BEFORE_RSP", False)
     APPLY_THEN_WAIT_UNTIL_APPLIED_REQ = ("APPLY_THEN_WAIT_UNTIL_APPLIED_REQ", True)
+    # replica-state auditor (messages/audit.py): read-only cross-replica
+    # range digests + drill-down entry fetches — never journaled
+    AUDIT_DIGEST_REQ = ("AUDIT_DIGEST_REQ", False)
+    AUDIT_DIGEST_RSP = ("AUDIT_DIGEST_RSP", False)
+    AUDIT_ENTRIES_REQ = ("AUDIT_ENTRIES_REQ", False)
+    AUDIT_ENTRIES_RSP = ("AUDIT_ENTRIES_RSP", False)
     SIMPLE_RSP = ("SIMPLE_RSP", False)
     FAILURE_RSP = ("FAILURE_RSP", False)
     # local-only (never cross the network; applied via Node.local_request)

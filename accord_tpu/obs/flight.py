@@ -72,6 +72,16 @@ EVENT_KINDS = {
                         "safe-to-clean sweep (coordinate/infer.py, "
                         "coordinate/recover.py, local/cleanup.py); "
                         "data=(site, merged_status)",
+    "audit_digest": "cross-replica range-digest round settled "
+                    "(local/audit.py); data=(range_start, range_end, "
+                    "replicas, outcome)",
+    "audit_divergence": "replica-state divergence confirmed by the audit "
+                        "drill-down (local/audit.py), trace id = the "
+                        "divergent txn; data=(kind, range_start, "
+                        "range_end, disagreeing_nodes)",
+    "census_sweep": "state-lifecycle census sweep completed "
+                    "(local/audit.py); data=(resident, "
+                    "quiescent_uncleaned, bytes_est)",
 }
 
 

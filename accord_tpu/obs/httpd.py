@@ -6,6 +6,8 @@
     GET /metrics.json   the NodeObs snapshot (metrics + summary), JSON
     GET /flight         the node's flight-recorder tail (?limit=N), JSON
     GET /flight?txn=ID  one trace id's flight events on this node, JSON
+    GET /audit          live replica-state auditor view (divergences,
+                        last digest round, lifecycle census), JSON
 
 Multi-process clusters on one machine offset the base port by the node id
 (node N binds base + N - 1); base 0 binds an ephemeral port (recorded on
@@ -47,6 +49,12 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps({"node": obs.node_id, "txn": txn,
                                "recorded_total": flight.recorded_total,
                                "events": [list(e) for e in events]}).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/audit"):
+            # live replica-state auditor view (divergences, last digest
+            # round, census) — {} when no Auditor is attached to this node
+            view = obs.audit_view() if obs.audit_view is not None else {}
+            body = json.dumps(view).encode()
             ctype = "application/json"
         elif self.path.startswith("/metrics"):
             body = obs.registry.render_prometheus().encode()

@@ -29,7 +29,7 @@ class NodeObs:
     """Per-node metrics registry + span store + instrumentation helpers."""
 
     __slots__ = ("node_id", "registry", "spans", "flight", "enabled",
-                 "_clock_us")
+                 "_clock_us", "audit_view")
 
     def __init__(self, node_id: int = 0, registry: Optional[Registry] = None,
                  clock_us: Optional[Callable[[], int]] = None,
@@ -44,6 +44,11 @@ class NodeObs:
         # node's clock — stitched across replicas on failure
         self.flight = FlightRecorder(node_id, capacity=flight_capacity,
                                      clock_us=self._clock_us)
+        # live replica-state audit view: the node's Auditor (local/audit.py)
+        # installs its `view` callable here so the metrics endpoint's
+        # /audit route and host "audit" frames can serve it; None when no
+        # auditor is attached
+        self.audit_view: Optional[Callable[[], dict]] = None
 
     def now_us(self) -> int:
         return int(self._clock_us())

@@ -47,6 +47,32 @@ def _gauge_max(metrics: dict, name: str) -> int:
     return max(vals) if vals else 0
 
 
+def _gauge_sum_by_label(metrics: dict, name: str, label: str) -> Dict[str, int]:
+    """Sum one gauge family across label sets grouped by `label` — census
+    gauges carry a node label, so the cross-node merge (max per label set)
+    keeps per-node values distinct and summing over them is the cluster
+    total."""
+    out: Dict[str, int] = {}
+    for lk, v in metrics.get("gauges", {}).get(name, {}).items():
+        key = parse_labels(lk).get(label, "")
+        out[key] = out.get(key, 0) + v
+    return out
+
+
+def _gauge_total(metrics: dict, name: str) -> int:
+    return sum(metrics.get("gauges", {}).get(name, {}).values())
+
+
+def _gauge_max_by_label(metrics: dict, name: str, label: str
+                        ) -> Dict[str, int]:
+    """Worst (max) value of one gauge family grouped by `label`."""
+    out: Dict[str, int] = {}
+    for lk, v in metrics.get("gauges", {}).get(name, {}).items():
+        key = parse_labels(lk).get(label, "")
+        out[key] = max(out.get(key, v), v)
+    return out
+
+
 def _hists_by_label(metrics: dict, name: str, label: str) -> Dict[str, dict]:
     """Merge one histogram family's snapshots grouped by a label value."""
     out: Dict[str, dict] = {}
@@ -212,6 +238,40 @@ def summarize(metrics: dict) -> dict:
                 metrics, "accord_pipeline_queue_wait_us")),
         },
         "infer": _infer_section(metrics),
+        "audit": {
+            # replica-state auditor (local/audit.py): digest-round
+            # outcomes, confirmed divergences by kind, drill-down volume
+            "rounds": _counter_by_label(metrics,
+                                        "accord_audit_rounds_total",
+                                        "outcome"),
+            "mismatches": _counter_total(metrics,
+                                         "accord_audit_mismatch_total"),
+            "divergences": _counter_by_label(
+                metrics, "accord_audit_divergence_total", "kind"),
+            "drill_requests": _counter_total(metrics,
+                                             "accord_audit_drill_total"),
+            "entries_checked": _counter_total(
+                metrics, "accord_audit_entries_total"),
+        },
+        "census": {
+            # state-lifecycle census (local/audit.py): cluster-wide
+            # resident totals by class, cleanup-leak alarms, and the
+            # worst per-node cleanup lag per watermark kind
+            "sweeps": _counter_total(metrics, "accord_census_sweeps_total"),
+            "resident": _gauge_total(metrics,
+                                     "accord_census_resident_total"),
+            "by_class": _gauge_sum_by_label(metrics,
+                                            "accord_census_resident",
+                                            "cls"),
+            "quiescent_uncleaned": _gauge_total(
+                metrics, "accord_census_quiescent_uncleaned"),
+            "resident_bytes_est": _gauge_total(
+                metrics, "accord_census_resident_bytes_est"),
+            "leak_alarms": _counter_total(
+                metrics, "accord_census_leak_alarms_total"),
+            "watermark_lag_us": _gauge_max_by_label(
+                metrics, "accord_watermark_lag_us", "kind"),
+        },
         "journal": {
             "appends": _counter_total(metrics,
                                       "accord_journal_appends_total"),

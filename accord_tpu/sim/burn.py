@@ -81,7 +81,13 @@ class BurnRun:
                  restarts: int = 0,
                  journal_dir: Optional[str] = None,
                  restart_down_s: float = 2.0,
-                 eph_ratio: float = 0.0):
+                 eph_ratio: float = 0.0,
+                 audit: bool = True,
+                 audit_live_s: float = 0.0,
+                 census_live_s: float = 0.0,
+                 audit_kw: Optional[dict] = None,
+                 corrupt_at: Optional[int] = None,
+                 corrupt_invalidated: bool = False):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -159,6 +165,22 @@ class BurnRun:
         # injected invariant violation exercising the forensics path —
         # tests/test_flight.py)
         self.fault_injector = None
+        # replica-state auditor (local/audit.py): passive auditors on every
+        # node for the ALWAYS-ON end-of-run digest+census checker; live
+        # periodic auditing (the production cadence) via audit_live_s /
+        # census_live_s.  The corruption nemesis (sim/corruption.py)
+        # silently mutates one replica's decided state mid-run — the
+        # divergence the end-of-run checker must then report.
+        self.audit = audit
+        if audit:
+            self.cluster.attach_auditors(interval_s=audit_live_s,
+                                         census_interval_s=census_live_s,
+                                         **(audit_kw or {}))
+        self._corrupt_at = corrupt_at
+        self._corrupt_invalidated = corrupt_invalidated
+        self.corrupted_txn = None
+        self.corrupted_node: Optional[int] = None
+        self.audit_rounds: list = []
         self.stats = BurnStats()
         self.next_value = 0
         self._value_owner: Dict[int, dict] = {}
@@ -237,6 +259,72 @@ class BurnRun:
 
         queue.add(0, do_kill)
 
+    # ---------------------------------------------------- corruption arm --
+    def _maybe_corrupt(self) -> None:
+        """Fire the scheduled out-of-band corruption once enough ops
+        completed: silently mutate one committed-below-universal command on
+        a random live replica (sim/corruption.py).  Eligibility depends on
+        the durability rounds having certified a window — retried on a
+        virtual-time backoff until a victim txn exists."""
+        if self._corrupt_at is None or self.corrupted_txn is not None:
+            return
+        done_ops = (self.stats.acks + self.stats.nacks + self.stats.shed
+                    + self.stats.lost)
+        if done_ops < self._corrupt_at:
+            return
+        self._corrupt_at = None  # schedule exactly one injection chain
+        victim = self.rng.pick(self.cluster.live_node_ids())
+
+        def do_corrupt():
+            from accord_tpu.sim.corruption import corrupt_below_universal
+            txn = corrupt_below_universal(
+                self.cluster, victim,
+                flip_invalidated=self._corrupt_invalidated)
+            if txn is None:
+                # no certified window yet: wait for a durability round
+                self.cluster.queue.add(1_000_000, do_corrupt)
+                return
+            self.corrupted_txn = txn
+            self.corrupted_node = victim
+
+        self.cluster.queue.add(0, do_corrupt)
+
+    # ------------------------------------------------ end-of-run auditing --
+    def _run_end_audit(self) -> None:
+        """The always-on audit checker: at quiesce every shard's digests
+        must agree across its replicas (at whatever truncation points they
+        reached), and any recorded divergence fails the burn with the
+        divergent txn's stitched cross-replica flight timeline.  Rounds a
+        lossy link left inconclusive are retried a few passes; live-audit
+        timers are stopped first so passes do not interleave."""
+        auditors = self.cluster.auditors
+        for a in auditors.values():
+            a.stop()
+            a.census_once()
+        for _attempt in range(4):
+            done = {}
+            for nid, a in auditors.items():
+                a.audit_once(on_done=lambda r, n=nid: done.__setitem__(n, r))
+            self.cluster.process_until(
+                lambda: len(done) == len(auditors), max_items=2_000_000)
+            reports = [r for r in done.values() if r is not None]
+            outcomes = [rd["outcome"] for r in reports
+                        for rd in r["rounds"]]
+            if outcomes and "inconclusive" not in outcomes:
+                break
+        self.audit_rounds = [rd for r in reports for rd in r["rounds"]]
+        divs = [d for a in auditors.values() for d in a.divergences]
+
+        def check():
+            assert not divs, (
+                "audit divergence: " + "; ".join(
+                    f"txn {d['txn']} {d['kind']} on range "
+                    f"[{d['range'][0]},{d['range'][1]}) across nodes "
+                    f"{sorted(d['nodes'])} (replicas {d['replicas']})"
+                    for d in divs[:4]))
+
+        self._with_flight_artifact(check)
+
     # --------------------------------------------------------------- run --
     def run(self) -> BurnStats:
         cluster = self.cluster
@@ -288,6 +376,7 @@ class BurnRun:
                 else:
                     self.stats.lost += 1
                 self._maybe_kill()
+                self._maybe_corrupt()
                 # pipeline: keep `concurrency` txns in flight
                 submit_one()
 
@@ -335,6 +424,12 @@ class BurnRun:
                  + self.stats.lost + self.stats.pending)
         assert tally == submitted[0], \
             f"op accounting leak: {self.stats} vs submitted={submitted[0]}"
+
+        # always-on audit checker: cross-replica range digests must agree
+        # at quiesce; divergences (e.g. the corruption arm's silent
+        # mutation) fail the burn with the stitched flight timeline
+        if self.audit:
+            self._run_end_audit()
 
         # final histories: majority agreement across replicas per key
         final = self._with_flight_artifact(self._final_histories)
@@ -527,6 +622,21 @@ def main(argv=None) -> int:
     parser.add_argument("--eph-heavy", action="store_true",
                         help="~half of ops become single-key reads on the "
                              "ephemeral (never-witnessed) read path")
+    parser.add_argument("--no-audit", action="store_true",
+                        help="disable the always-on end-of-run replica-"
+                             "state audit checker (local/audit.py)")
+    parser.add_argument("--audit-live", type=float, default=0.0,
+                        metavar="S",
+                        help="run the periodic live audit+census every S "
+                             "virtual seconds during the burn (the "
+                             "production cadence; 0 = end-of-run only)")
+    parser.add_argument("--corrupt", type=int, nargs="?", const=0,
+                        default=None, metavar="N",
+                        help="corruption nemesis: after N completed ops "
+                             "(default ops/2) silently mutate one "
+                             "committed command on a random replica — the "
+                             "audit checker must then FAIL the burn "
+                             "naming the divergent txn")
     parser.add_argument("--message-stats", action="store_true",
                         help="print per-message-type delivery/drop counters")
     parser.add_argument("--trace", action="store_true",
@@ -595,7 +705,12 @@ def main(argv=None) -> int:
                       trace=args.trace, pipeline=args.pipeline,
                       restarts=args.restart, journal_dir=journal_dir,
                       restart_down_s=args.down,
-                      eph_ratio=0.5 if args.eph_heavy else 0.0)
+                      eph_ratio=0.5 if args.eph_heavy else 0.0,
+                      audit=not args.no_audit,
+                      audit_live_s=args.audit_live,
+                      census_live_s=args.audit_live,
+                      corrupt_at=(None if args.corrupt is None
+                                  else (args.corrupt or args.ops // 2)))
         stats = run.run()
         if args.trace:
             for node in run.cluster.nodes.values():
@@ -658,6 +773,12 @@ def main(argv=None) -> int:
                       f"no_round={inf['no_round_commits']} "
                       f"fence_refusals={inf['fence_refusals']} "
                       f"safe_to_clean={inf['safe_to_clean']}]")
+
+        if run.audit_rounds:
+            agree = sum(1 for r in run.audit_rounds
+                        if r["outcome"] == "agree")
+            extra += (f" audit[rounds={len(run.audit_rounds)} "
+                      f"agree={agree}]")
 
         def lat(pct):
             us = stats.latency_us(pct)

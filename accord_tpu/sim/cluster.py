@@ -136,6 +136,10 @@ class SimCluster:
         # epoch ledger backing each node's ConfigurationService fetches
         self.topology_ledger: Dict[int, Topology] = {1: self.topology}
         self.config_services: Dict[int, object] = {}
+        # live replica-state auditors (local/audit.py), one per node once
+        # attach_auditors is called; restart_node rebuilds the victim's
+        self.auditors: Dict[int, object] = {}
+        self._auditor_kw: Optional[dict] = None
         # per-node build args retained so restart_node can rebuild an
         # identically configured replica
         self._num_command_stores = num_command_stores
@@ -249,6 +253,32 @@ class SimCluster:
                 node, shard_cycle_s=shard_cycle_s,
                 global_cycle_every=global_cycle_every).start()
 
+    # ------------------------------------------------------------ auditing --
+    def attach_auditors(self, interval_s: float = 0.0,
+                        census_interval_s: float = None, **kw) -> None:
+        """One replica-state auditor per node (local/audit.py).  With
+        interval_s/census_interval_s > 0 the periodic timers arm on the
+        shared virtual-time scheduler (the live-audit arm); at 0 the
+        auditors are passive and a harness drives audit_once/census_once
+        explicitly (the burn's end-of-run checker)."""
+        from accord_tpu.local.audit import Auditor
+        self._auditor_kw = dict(interval_s=interval_s,
+                                census_interval_s=census_interval_s, **kw)
+        for nid, node in self.nodes.items():
+            if nid in self.dead:
+                continue
+            a = Auditor(node, **self._auditor_kw)
+            a.start()
+            self.auditors[nid] = a
+
+    def _attach_auditor(self, nid: int) -> None:
+        if self._auditor_kw is None:
+            return
+        from accord_tpu.local.audit import Auditor
+        a = Auditor(self.nodes[nid], **self._auditor_kw)
+        a.start()
+        self.auditors[nid] = a
+
     # --------------------------------------------------- crash-restart nemesis --
     def live_node_ids(self) -> List[int]:
         return sorted(set(self.nodes) - self.dead)
@@ -275,6 +305,9 @@ class SimCluster:
         node.journal = None  # a dead process journals nothing
         self.agents[node_id].dead = True
         self.pipelines.pop(node_id, None)
+        auditor = self.auditors.pop(node_id, None)
+        if auditor is not None:
+            auditor.stop()
         # close the WAL file handles; un-synced OS buffers survive a
         # process kill, so nothing acked is lost (sync mode anyway)
         self.journal.close_node(node_id)
@@ -306,6 +339,7 @@ class SimCluster:
             from accord_tpu.pipeline import Pipeline
             self.pipelines[node_id] = Pipeline(node, self.scheduler,
                                                self._pipeline_config)
+        self._attach_auditor(node_id)
         return node
 
     # ----------------------------------------------------------- execution --

@@ -56,9 +56,9 @@ def _platform_class(platform: str) -> str:
     return "cpu" if platform.startswith("cpu") else "device"
 
 
-# configs whose metric is a time (lower is better); everything else is a
-# throughput (higher is better)
-LOWER_IS_BETTER = {"tpcc"}
+# configs whose metric is a time/overhead (lower is better); everything
+# else is a throughput (higher is better)
+LOWER_IS_BETTER = {"tpcc", "audit"}
 
 
 def _regression_guard(result: dict) -> None:
@@ -1283,6 +1283,97 @@ def bench_tpcc(n_txns=1_000_000, warehouses=64, window=2048, seed=42):
     }))
 
 
+# ---------------------------------------------------------------- audit ----
+
+def bench_audit(ops=300, seed=11):
+    """Audit/census overhead lane (ISSUE 7 acceptance): the measured cost
+    of the always-on replica-state auditor, recorded as a percentage of
+    the scalar active-scan hot loop.
+
+    A small real burn populates a 3-replica cluster, then one full
+    digest walk (every resident command, unbounded window — the worst
+    case; production rounds cover only the certified [lo, hi) slice) plus
+    one census sweep is timed per node.  `value` = per-resident-command
+    sweep cost / per-transaction scalar deps cost x 100.  Steady-state
+    model: each audit round folds every resident command once; any
+    workload that admits at least one transaction per resident command
+    per audit interval therefore pays at most `value` percent — the <2%
+    budget tests/test_obs_budget.py enforces."""
+    from accord_tpu.local.audit import census_node, digest_node
+    from accord_tpu.primitives.keys import Ranges
+    from accord_tpu.primitives.timestamp import Timestamp, TXNID_NONE
+    from accord_tpu.sim.burn import BurnRun
+
+    run = BurnRun(seed, ops, durability_cycle_s=2.0,
+                  topology_changes=False)
+    run.run()
+    cluster = run.cluster
+    hi = Timestamp(1 << 30, 0, 0, 0)
+    total_cmds = sum(len(s.commands) for n in cluster.nodes.values()
+                     for s in n.command_stores.all())
+    best = None
+    folded = 0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        folded = 0
+        for node in cluster.nodes.values():
+            topo = node.topology.current()
+            for shard in topo.shards:
+                if node.id in shard.nodes:
+                    _d, n = digest_node(node, Ranges([shard.range]),
+                                        TXNID_NONE, hi)
+                    folded += n
+            census_node(node)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    per_cmd_us = best / max(1, total_cmds) * 1e6
+
+    # the scalar hot-loop yardstick: one active-conflict scan per replica
+    # (rf=3) over a 1024-entry per-key history — the same txn cost model
+    # the obs budget tests price against (tests/test_obs_budget.py)
+    from accord_tpu.local.cfk import CommandsForKey, InternalStatus
+    from accord_tpu.primitives.keys import Key
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    from accord_tpu.utils.random_source import RandomSource
+    rng = RandomSource(3)
+    cfk = CommandsForKey(Key(1))
+    statuses = [InternalStatus.PREACCEPTED, InternalStatus.ACCEPTED,
+                InternalStatus.COMMITTED, InternalStatus.STABLE,
+                InternalStatus.APPLIED]
+    hlc = 1000
+    for _ in range(1024):
+        hlc += 1 + rng.next_int(2)
+        cfk.update(TxnId.create(1, hlc, rng.pick([TxnKind.READ,
+                                                  TxnKind.WRITE]),
+                                Domain.KEY, rng.next_int(8)),
+                   rng.pick(statuses), None)
+    probe = TxnId.create(1, hlc + 10, TxnKind.WRITE, Domain.KEY, 2)
+    kinds = probe.kind.witnesses()
+    sink = []
+    loop_best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(200):
+            for _replica in range(3):
+                sink.clear()
+                cfk.map_reduce_active(probe, kinds, sink.append)
+        dt = (time.perf_counter() - t0) / 200 * 1e6
+        loop_best = dt if loop_best is None else min(loop_best, dt)
+
+    pct = per_cmd_us / loop_best * 100.0
+    emit({
+        "metric": "audit_census_overhead_pct_of_scalar",
+        "value": round(pct, 3),
+        "unit": "pct",
+        "budget_pct": 2.0,
+        "sweep_us_per_resident_cmd": round(per_cmd_us, 3),
+        "scalar_txn_us": round(loop_best, 1),
+        "resident_cmds": total_cmds,
+        "digest_folded": folded,
+        "audit_rounds_at_quiesce": len(run.audit_rounds),
+    })
+
+
 # ------------------------------------------------------------------ slo ----
 
 # open-loop SLO lanes (workload/openloop.py): named profiles driven through
@@ -1692,7 +1783,8 @@ def main():
                              "maelstrom", "maelstrom-rw", "tcp",
                              "pipeline", "scalar", "journal",
                              "slo-zipf", "slo-range", "slo-tpcc",
-                             "slo-ephemeral", "slo-tcp", "ephemeral"])
+                             "slo-ephemeral", "slo-tcp", "ephemeral",
+                             "slo-journal", "audit"])
     ap.add_argument("--guard", action="store_true",
                     help="after the run, diff the row (headline + per-"
                          "kernel profile p50s) against the last clean "
@@ -1735,7 +1827,7 @@ def main():
     if ns.config not in ("maelstrom", "maelstrom-rw", "tcp", "pipeline",
                          "scalar", "journal", "slo-zipf", "slo-range",
                          "slo-tpcc", "slo-ephemeral", "slo-tcp",
-                         "ephemeral"):
+                         "ephemeral", "slo-journal", "audit"):
         # device-using configs probe the (possibly dead-tunneled) backend
         # first; host-only configs never touch the chip
         from accord_tpu.utils.backend import resolve_platform
@@ -1765,6 +1857,19 @@ def main():
     elif ns.config == "ephemeral":
         bench_slo_tcp("ephemeral", "ephemeral_read_heavy", ops=400,
                       rate_per_s=100.0)
+    elif ns.config == "slo-journal":
+        # the durability tier in the tail story (ISSUE 7 satellite): the
+        # zipfian open-loop lane with the fsync-durable WAL in every node
+        # process (group commit, durability-gated acks).  The stall arm
+        # rides ACCORD_JOURNAL_STALL_US/_AFTER — injected in the WAL
+        # flush thread, not at the coordinator door (journal/wal.py).
+        import tempfile
+        os.environ.setdefault(
+            "ACCORD_JOURNAL",
+            tempfile.mkdtemp(prefix="accord-slo-journal-"))
+        bench_slo_tcp("slo-journal", "zipfian", ops=400, rate_per_s=80.0)
+    elif ns.config == "audit":
+        bench_audit()
     else:
         bench_rangestress()
     if ns.guard:

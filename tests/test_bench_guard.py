@@ -122,3 +122,33 @@ def test_slo_guard_exits_nonzero_on_tail_only_regression(tmp_path):
     assert lane["host"]["slo"]["open_loop"]["p99_us"] == baseline_p99
     assert any(e.get("guard_failed") and e.get("stale")
                for e in lane["superseded"])
+
+
+# ----------------------------------------- audit + durability lanes (ISSUE 7) --
+
+def test_audit_lane_guard_dry_run_parses_history():
+    """The audit/census overhead lane's recorded row must stay guard-
+    parseable (it is LOWER_IS_BETTER: an overhead increase, not a
+    throughput drop, is the regression)."""
+    proc = _run(["--config", "audit", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "audit_guard" and row["dry_run"] is True
+    assert row["baselines"], "no audit baseline in BENCH_HISTORY.json"
+    # the acceptance bound rides in the row itself: overhead < 2%
+    hist = json.load(open(os.path.join(
+        REPO, os.environ.get("ACCORD_BENCH_HISTORY", "BENCH_HISTORY.json"))))
+    assert hist["audit"]["host"]["value"] < 2.0
+
+
+def test_slo_journal_lane_guard_dry_run_validates_schema():
+    """The durable-WAL SLO lane (fsync-stall arm's home) must carry a
+    schema-valid exact-sample SLO row like every other slo-* lane."""
+    proc = _run(["--config", "slo-journal", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "slo-journal_guard" and row["dry_run"] is True
+    assert row["baselines"], "no slo-journal baseline in BENCH_HISTORY.json"
+    base = row["baselines"][0]
+    assert base["slo_open_p99_us"] > 0
+    assert "admission" in base["slo_phases"]

@@ -196,6 +196,65 @@ def test_flight_ring_is_bounded():
     assert len(fl) == 64 and fl.recorded_total == 1000
 
 
+# ------------------------------------------------- audit/census budget ----
+
+def _populated_node(n_cmds=2048, keyspan=500):
+    """A single-node cluster whose store holds n_cmds decided commands —
+    the resident set one audit digest walk + census sweep must cover."""
+    from accord_tpu.local.command import Command
+    from accord_tpu.local.status import SaveStatus
+    from accord_tpu.primitives.keys import Route, RoutingKey, RoutingKeys
+    from accord_tpu.primitives.timestamp import Domain, Timestamp, TxnId, \
+        TxnKind
+    from accord_tpu.sim.cluster import SimCluster
+    cluster = SimCluster(n_nodes=1, n_shards=2)
+    node = cluster.nodes[1]
+    store = node.command_stores.all()[0]
+    for i in range(n_cmds):
+        tid = TxnId.create(1, 1000 + i, TxnKind.WRITE, Domain.KEY, 1)
+        cmd = Command(tid)
+        cmd.save_status = SaveStatus.APPLIED
+        cmd.execute_at = Timestamp(1, 1000 + i, 0, 1)
+        tok = i % keyspan
+        cmd.route = Route.of_keys(RoutingKey(tok), RoutingKeys.of(tok))
+        store.commands[tid] = cmd
+    return node
+
+
+def _audit_census_cost_per_cmd_us(n_cmds=2048):
+    """min-of-3 per-resident-command cost of ONE full digest walk (every
+    command folded — the unbounded worst case; production rounds cover
+    only the certified window) plus one census sweep."""
+    from accord_tpu.local.audit import census_node, digest_node
+    from accord_tpu.primitives.keys import Ranges
+    from accord_tpu.primitives.timestamp import Timestamp, TXNID_NONE
+    node = _populated_node(n_cmds)
+    ranges = Ranges.of((0, 1000))
+    hi = Timestamp(1 << 20, 0, 0, 0)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _d, folded = digest_node(node, ranges, TXNID_NONE, hi)
+        census_node(node)
+        dt = (time.perf_counter() - t0) / n_cmds * 1e6
+        best = dt if best is None else min(best, dt)
+    assert folded == n_cmds
+    return best
+
+
+def test_audit_census_overhead_under_2pct_of_scalar_hot_loop():
+    """ISSUE 7 acceptance: the always-on audit digest + census sweep must
+    cost <2% of the scalar hot loop per resident command (each audit round
+    folds every resident command once; any workload admitting >= 1 txn per
+    resident command per round therefore pays < 2% per txn)."""
+    audit_us = _audit_census_cost_per_cmd_us()
+    loop_us = _scalar_hot_loop_cost_us()
+    ratio = audit_us / loop_us
+    assert ratio < 0.02, (
+        f"audit+census sweep {audit_us:.2f}us/cmd vs scalar hot loop "
+        f"{loop_us:.1f}us per txn: {ratio:.1%} >= 2% budget")
+
+
 # ------------------------------------------------- profiler-off budget ----
 
 def _profiler_off_bundle_cost_us(reps=2000):

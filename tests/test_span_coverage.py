@@ -172,6 +172,20 @@ def test_infer_ladder_kinds_are_covered():
     assert any(p.startswith("local") for p in sites), sites
 
 
+def test_audit_kinds_are_covered():
+    """The replica-state auditor's forensics hooks must stay on the ring:
+    every digest-round settlement, every confirmed divergence (stamped
+    with the divergent txn's trace id), and every census sweep.  Pinned as
+    a SET like the journal lifecycle below, so a hook cannot vanish
+    together with its EVENT_KINDS row."""
+    recorded = _recorded_flight_kinds()
+    for kind in ("audit_digest", "audit_divergence", "census_sweep"):
+        assert kind in EVENT_KINDS, f"{kind} missing from EVENT_KINDS"
+        assert kind in recorded, f"nothing records {kind}"
+        assert any(p.startswith("local") for p in recorded[kind]), \
+            (kind, recorded[kind])
+
+
 def test_journal_lifecycle_kinds_are_covered():
     """The durable WAL's full lifecycle must stay on the forensics ring:
     append, segment rotation, snapshot compaction, and both replay edges.

@@ -141,5 +141,10 @@ def test_flight_frame_over_tcp_cluster():
         one = c.fetch_flight(1, txn=tids[-1])
         assert one["events"] and all(e[3] == tids[-1]
                                      for e in one["events"])
+        # the replica-state audit view rides the same transport: the
+        # default-on auditor (ACCORD_AUDIT_S) serves divergences + census
+        audit = c.fetch_audit(1)
+        assert audit is not None and audit["node"] == 1
+        assert audit["divergences"] == []
     finally:
         c.close()

@@ -361,3 +361,55 @@ def test_bench_journal_lane_group_commit_wins(tmp_path):
     group = run_mode(2000, 2000, "group")
     sync = run_mode(0, 250, "sync")
     assert group > 2.0 * sync, (group, sync)
+
+
+def test_journal_fsync_stall_charged_to_open_loop_tail(tmp_path):
+    """ISSUE 7 satellite (PR 6 residual): the SLO stall arm injected in
+    the WAL FLUSH THREAD, not at the coordinator door.  Appends arrive on
+    an open-loop schedule; once the stall fires, every durability-gated
+    ack behind that group-commit window waits out the stalled fsync — so
+    the open-loop (intended-start) tail inflates by ~the stall while an
+    unstalled run's tail stays far below it."""
+    import time as _time
+
+    from accord_tpu.journal.wal import JournalConfig, WriteAheadLog
+    from accord_tpu.obs.report import exact_quantiles_us
+
+    msg = _sample_msg()
+
+    def run_mode(subdir, stall_us):
+        d = str(tmp_path / subdir)
+        cfg = JournalConfig(d, fsync_window_us=1500,
+                            segment_bytes=64 << 20, snapshot_segments=0,
+                            stall_us=stall_us, stall_after=60)
+        wal = WriteAheadLog(d, config=cfg, retain=False)
+        total = 150
+        spacing_us = 1000
+        lat: list = []
+        done = threading.Semaphore(0)
+        t0 = _time.perf_counter()
+        for i in range(total):
+            intended = t0 + i * spacing_us / 1e6
+            now = _time.perf_counter()
+            if now < intended:
+                _time.sleep(intended - now)
+            seq = wal.append(msg)
+
+            def acked(at=intended):
+                lat.append(int((_time.perf_counter() - at) * 1e6))
+                done.release()
+
+            wal.on_durable(seq, acked)
+        for _ in range(total):
+            done.acquire()
+        stalls = wal.registry.value("accord_journal_stall_total")
+        wal.close()
+        return exact_quantiles_us(lat), stalls
+
+    stalled, n_stalls = run_mode("stalled", 250_000)
+    clean, n_clean = run_mode("clean", 0)
+    assert n_stalls == 1 and n_clean == 0
+    # the stall lands in the tail: p99 within [0.5x, ~2x] of the injected
+    # stall, while the clean run's p99 stays an order of magnitude below
+    assert stalled["p99_us"] > 125_000, stalled
+    assert clean["p99_us"] < 50_000, clean

@@ -201,6 +201,9 @@ def _synthesize(gen: _Gen):
     from accord_tpu.primitives.keys import Key
     from accord_tpu.primitives.timestamp import Domain, TxnKind
 
+    from accord_tpu.messages.audit import (AuditDigest, AuditDigestOk,
+                                           AuditEntries, AuditEntriesOk)
+
     tid = gen.txn_id()
     keys = gen.keys()
     route = gen.route(keys)
@@ -228,6 +231,18 @@ def _synthesize(gen: _Gen):
                         gen.ts()),
         FetchSnapshotNack(),
         FailureReply(Timeout("synthesized")),
+        # replica-state auditor verbs (ISSUE 7): the digest round-trip is
+        # the cross-replica comparison's foundation — an asymmetry here
+        # would fabricate (or mask) divergences
+        AuditDigest(gen.ranges(), gen.txn_id(), gen.txn_id()),
+        AuditDigestOk(f"{gen.rng.next_int(0, 1 << 30):032x}",
+                      gen.rng.next_int(0, 500), gen.txn_id(), gen.txn_id()),
+        AuditEntries(gen.ranges(), gen.txn_id(), gen.txn_id(),
+                     limit=64 + gen.rng.next_int(0, 64)),
+        AuditEntriesOk(((gen.txn_id(), "committed", gen.ts()),
+                        (gen.txn_id(), "invalidated", None),
+                        (gen.txn_id(), "unknown", None)),
+                       truncated=gen.rng.next_bool()),
         # the extended CheckStatusOk/KnownMap wire shape (Infer ladder):
         # randomized Known vectors incl. the InvalidIf lattice component
         gen.check_status_ok(),
