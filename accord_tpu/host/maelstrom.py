@@ -95,17 +95,34 @@ class HostAgent(Agent):
 
 
 class MaelstromSink(CallbackSink):
-    """MessageSink writing Maelstrom envelopes (reference Wrapper/Packet)."""
+    """MessageSink writing Maelstrom envelopes (reference Wrapper/Packet).
+
+    A single-slot encode memo (identity-keyed) covers the fan-out pattern:
+    Node.send encodes the SAME request object once per recipient — the
+    PreAccept/Commit/Apply rounds each pay one structural walk instead of
+    rf of them.  Requests are never mutated between their fan-out sends
+    (the trace id is stamped before the first), so identity implies an
+    identical tree."""
 
     def __init__(self, host: "MaelstromHost"):
         super().__init__()
         self.host = host
+        self._memo_req = None
+        self._memo_tree = None
+
+    def _enc(self, request):
+        if self._memo_req is request:
+            return self._memo_tree
+        tree = encode_message(request)
+        self._memo_req = request
+        self._memo_tree = tree
+        return tree
 
     def send(self, to: int, request: Request) -> None:
         if self._capture(to, None, request):
             return
         self.host.emit_node(to, {"type": "accord",
-                                 "payload": encode_message(request)})
+                                 "payload": self._enc(request)})
 
     def send_with_callback(self, to: int, request: Request, callback,
                            executor=None) -> None:
@@ -113,10 +130,10 @@ class MaelstromSink(CallbackSink):
         if self._capture(to, msg_id, request):
             return
         self.host.emit_node(to, {"type": "accord", "msg_id": msg_id,
-                                 "payload": encode_message(request)})
+                                 "payload": self._enc(request)})
 
     def _send_prepared(self, to: int, reply_context, request) -> None:
-        body = {"type": "accord", "payload": encode_message(request)}
+        body = {"type": "accord", "payload": self._enc(request)}
         if reply_context is not None:
             body["msg_id"] = reply_context
         self.host.emit_node(to, body)
@@ -126,7 +143,7 @@ class MaelstromSink(CallbackSink):
             return
         self.host.emit_node(to, {"type": "accord",
                                  "in_reply_to": reply_context,
-                                 "payload": encode_message(reply)})
+                                 "payload": self._enc(reply)})
 
 
 class MaelstromHost:
@@ -312,11 +329,15 @@ class MaelstromHost:
         threading.Thread(target=reader, daemon=True).start()
         eof = False
         while self.running and not eof:
+            # due timers run BEFORE blocking: `min(timeout, 0.5) or 0.01`
+            # used to turn a due-now deadline (timeout == 0.0) into a 10ms
+            # sleep — the host/tcp.py event loop fixed the same bug
+            self.scheduler.run_due()
             deadline = self.scheduler.next_deadline()
             timeout = (max(0.0, deadline - time.monotonic())
                        if deadline is not None else 0.5)
             try:
-                batch = [lines.get(timeout=min(timeout, 0.5) or 0.01)]
+                batch = [lines.get(timeout=min(timeout, 0.5))]
             except queue.Empty:
                 batch = []
             # pipeline mode: drain the stdin burst and process it under one

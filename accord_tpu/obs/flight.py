@@ -82,6 +82,11 @@ EVENT_KINDS = {
     "census_sweep": "state-lifecycle census sweep completed "
                     "(local/audit.py); data=(resident, "
                     "quiescent_uncleaned, bytes_est)",
+    "frame_coalesce": "message captured into a peer's transport egress "
+                      "buffer (host/tcp.py), trace id = the bundled "
+                      "message's; data=(peer, pending_in_buffer)",
+    "frame_flush": "per-peer egress buffer left as ONE coalesced wire "
+                   "frame (host/tcp.py); data=(peer, messages, bytes)",
 }
 
 

@@ -197,6 +197,8 @@ def summarize(metrics: dict) -> dict:
                                 "path")
     phase_hists = _hists_by_label(metrics, "accord_phase_latency_us",
                                   "phase")
+    frames = _counter_total(metrics, "accord_tcp_frames_total")
+    msgs = _counter_total(metrics, "accord_tcp_msgs_total")
     return {
         "fast_path": fast,
         "slow_path": slow,
@@ -236,6 +238,24 @@ def summarize(metrics: dict) -> dict:
             # pipeline's contribution to the SLO lanes' "admission" phase
             "queue_wait_us": _hist_report(_merged_hist(
                 metrics, "accord_pipeline_queue_wait_us")),
+        },
+        "transport": {
+            # per-peer frame coalescing at the TCP egress buffer
+            # (host/tcp.py): how many protocol messages each wire frame
+            # amortised, and the frame-size shape — the coalescing-ratio
+            # surface the tcp/multicore bench rows record
+            "frames": frames,
+            "msgs": msgs,
+            "coalesce_ratio": (round(msgs / frames, 3) if frames else None),
+            "frame_bytes": _hist_report(_merged_hist(
+                metrics, "accord_tcp_frame_bytes")),
+            "frame_msgs": _hist_report(_merged_hist(
+                metrics, "accord_tcp_frame_msgs")),
+            "shed": _counter_total(metrics, "accord_tcp_peer_shed_total"),
+            "send_drops": _counter_total(
+                metrics, "accord_tcp_peer_send_drops_total"),
+            "retries": _counter_total(metrics,
+                                      "accord_tcp_peer_retries_total"),
         },
         "infer": _infer_section(metrics),
         "audit": {

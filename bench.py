@@ -104,6 +104,12 @@ def _regression_guard(result: dict) -> None:
             # p50/p99/p99.9 overall and per phase — `--guard` gates the
             # tails, not just the headline throughput
             entry["slo"] = result["slo"]
+        for key in ("per_procs", "cpus_available",
+                    "scaling_first_to_last"):
+            # multicore lane: the per-process-count scaling table IS the
+            # row's point — persist it next to the headline
+            if key in result:
+                entry[key] = result[key]
         lane = history.setdefault(CONFIG, {})
         old = lane.get(pclass)
         if old is not None:
@@ -1045,6 +1051,109 @@ def bench_pipeline(nodes=3, keys=100, n_ops=400, seed=7):
               metric="pipeline_tcp_host_txn_per_sec", extra_fields=extra)
 
 
+# ----------------------------------------------------------- multicore -----
+
+def bench_multicore(n_ops_per_node=200, keys=50, procs_list=(1, 2, 4),
+                    depth=8, seed=7):
+    """Tentpole lane of the event-loop host rearchitecture: N INDEPENDENT
+    single-node Accord processes (one selector event loop, one GIL each)
+    pinned round-robin across the machine's available cores, each driven
+    by its own closed-loop client thread.  Per-node throughput holding
+    flat as processes are added IS the multi-core scaling story — the
+    old thread-per-connection host degraded per node as peers multiplied.
+
+    `cpus_available` documents the ceiling this box exposes: with fewer
+    cores than processes the aggregate can only stay flat (the lane then
+    measures scheduling overhead, not scaling), so the row records both
+    the per-count table and the 1->max aggregate ratio."""
+    import threading
+
+    from accord_tpu.host.tcp import TcpClusterClient
+
+    try:
+        cpus = sorted(os.sched_getaffinity(0))
+    except AttributeError:  # non-linux
+        cpus = [0]
+
+    def drive_one(idx: int, results: list) -> None:
+        import random
+        rng = random.Random(seed + idx)
+        c = TcpClusterClient(n_nodes=1,
+                             pin_cpus={1: cpus[idx % len(cpus)]})
+        try:
+            t0 = time.perf_counter()
+            sub = done = acked = 0
+
+            def sub_one():
+                nonlocal sub
+                k = rng.randrange(keys)
+                c.submit(1, [k], {k: sub + 1}, req=sub)
+                sub += 1
+
+            for _ in range(min(depth, n_ops_per_node)):
+                sub_one()
+            while done < n_ops_per_node:
+                frame = c.recv(30.0)
+                body = (frame or {}).get("body", {})
+                if body.get("type") != "submit_reply":
+                    continue
+                done += 1
+                if body.get("ok"):
+                    acked += 1
+                if sub < n_ops_per_node:
+                    sub_one()
+            dt = time.perf_counter() - t0
+            from accord_tpu.obs.report import merge_node_snapshots
+            snap = c.fetch_metrics(1)
+            merged = merge_node_snapshots([snap] if snap else [])
+            results[idx] = (acked, dt,
+                            merged["summary"] if merged["nodes"] else None)
+        finally:
+            c.close()
+
+    table = {}
+    obs_summary = None
+    for n_procs in procs_list:
+        results: list = [None] * n_procs
+        threads = [threading.Thread(target=drive_one, args=(i, results))
+                   for i in range(n_procs)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        acked = sum(r[0] for r in results if r)
+        assert acked > 0.9 * n_procs * n_ops_per_node, (n_procs, acked)
+        agg = acked / wall
+        table[str(n_procs)] = {
+            "aggregate_txn_per_s": round(agg, 1),
+            "per_node_txn_per_s": round(agg / n_procs, 1),
+            "acked": acked,
+            "wall_seconds": round(wall, 2),
+        }
+        if obs_summary is None:
+            obs_summary = results[0][2] if results[0] else None
+    first = table[str(procs_list[0])]["aggregate_txn_per_s"]
+    last = table[str(procs_list[-1])]["aggregate_txn_per_s"]
+    result = {
+        "metric": "multicore_aggregate_txn_per_sec",
+        "value": round(last, 1),
+        "unit": "txn/s",
+        "workload": f"{procs_list[-1]} independent single-node event-loop "
+                    f"processes pinned across cores, closed-loop clients",
+        "procs": list(procs_list),
+        "cpus_available": len(cpus),
+        "per_procs": table,
+        "scaling_first_to_last": round(last / first, 2) if first else None,
+        "ops_per_node": n_ops_per_node,
+        "client_inflight": depth,
+    }
+    if obs_summary is not None:
+        result["obs"] = obs_summary
+    emit(result)
+
+
 # ---------------------------------------------------------------- tpcc -----
 
 def _tpcc_resolve_core():
@@ -1784,7 +1893,7 @@ def main():
                              "pipeline", "scalar", "journal",
                              "slo-zipf", "slo-range", "slo-tpcc",
                              "slo-ephemeral", "slo-tcp", "ephemeral",
-                             "slo-journal", "audit"])
+                             "slo-journal", "audit", "multicore"])
     ap.add_argument("--guard", action="store_true",
                     help="after the run, diff the row (headline + per-"
                          "kernel profile p50s) against the last clean "
@@ -1827,7 +1936,7 @@ def main():
     if ns.config not in ("maelstrom", "maelstrom-rw", "tcp", "pipeline",
                          "scalar", "journal", "slo-zipf", "slo-range",
                          "slo-tpcc", "slo-ephemeral", "slo-tcp",
-                         "ephemeral", "slo-journal", "audit"):
+                         "ephemeral", "slo-journal", "audit", "multicore"):
         # device-using configs probe the (possibly dead-tunneled) backend
         # first; host-only configs never touch the chip
         from accord_tpu.utils.backend import resolve_platform
@@ -1870,6 +1979,8 @@ def main():
         bench_slo_tcp("slo-journal", "zipfian", ops=400, rate_per_s=80.0)
     elif ns.config == "audit":
         bench_audit()
+    elif ns.config == "multicore":
+        bench_multicore()
     else:
         bench_rangestress()
     if ns.guard:

@@ -141,6 +141,45 @@ def test_audit_lane_guard_dry_run_parses_history():
     assert hist["audit"]["host"]["value"] < 2.0
 
 
+# ------------------------------------ multicore + coalescing (ISSUE 8) --
+
+def test_multicore_lane_guard_dry_run_parses_history():
+    """The multi-core event-loop scaling lane must stay guard-parseable,
+    and its recorded row must carry the per-process-count scaling table
+    (per-node throughput IS the lane's point) plus the box's core count
+    so a future multi-core box re-baselines knowingly."""
+    proc = _run(["--config", "multicore", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "multicore_guard" and row["dry_run"] is True
+    assert row["baselines"], "no multicore baseline in BENCH_HISTORY.json"
+    hist = json.load(open(os.path.join(
+        REPO, os.environ.get("ACCORD_BENCH_HISTORY",
+                             "BENCH_HISTORY.json"))))
+    entry = hist["multicore"]["host"]
+    assert entry["cpus_available"] >= 1
+    table = entry["per_procs"]
+    assert set(table) >= {"1", "4"}
+    for stats in table.values():
+        assert stats["aggregate_txn_per_s"] > 0
+        assert stats["per_node_txn_per_s"] > 0
+
+
+def test_tcp_row_carries_coalescing_obs():
+    """ISSUE 8 acceptance: the scalar tcp row records the per-peer frame
+    coalescing ratio and frame-size histograms in its obs key."""
+    hist = json.load(open(os.path.join(
+        REPO, os.environ.get("ACCORD_BENCH_HISTORY",
+                             "BENCH_HISTORY.json"))))
+    transport = hist["tcp"]["host"]["obs"]["transport"]
+    assert transport["coalesce_ratio"] > 1.0, \
+        "coalescing default-on should bundle >1 message per frame"
+    assert transport["frames"] > 0 and transport["msgs"] > transport["frames"]
+    for hkey in ("frame_bytes", "frame_msgs"):
+        assert transport[hkey]["count"] > 0
+        assert transport[hkey]["p50"] is not None
+
+
 def test_slo_journal_lane_guard_dry_run_validates_schema():
     """The durable-WAL SLO lane (fsync-stall arm's home) must carry a
     schema-valid exact-sample SLO row like every other slo-* lane."""

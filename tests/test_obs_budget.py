@@ -255,6 +255,97 @@ def test_audit_census_overhead_under_2pct_of_scalar_hot_loop():
         f"{loop_us:.1f}us per txn: {ratio:.1%} >= 2% budget")
 
 
+# ---------------------------------------------- transport egress budget ----
+
+class _SinkSock:
+    """Swallows writes like an always-writable socket."""
+
+    def send(self, data):
+        return len(data)
+
+    def close(self):
+        pass
+
+
+class _FakeHost:
+    """The exact surface _PeerLane touches, minus real sockets/loop."""
+
+    my_id = 1
+    flush_tick_us = 0
+
+    def __init__(self):
+        from types import SimpleNamespace
+
+        from accord_tpu.obs.flight import FlightRecorder
+        from accord_tpu.obs.registry import Registry
+        self.flight = FlightRecorder(1, clock_us=lambda: 0)
+        self.node = SimpleNamespace(
+            obs=SimpleNamespace(registry=Registry()))
+        self.peers = {2: ("127.0.0.1", 1)}
+        self.dirty = []
+
+    def mark_dirty(self, lane):
+        self.dirty.append(lane)
+
+    def register(self, sock, events, lane):
+        pass
+
+    def unregister(self, sock):
+        pass
+
+
+def _egress_txn_bundle_cost_us(reps=300):
+    """min-of-3 per-txn cost of the coalescing egress buffer: 10 message
+    enqueues (every frame_coalesce flight record + trace extraction) plus
+    4 coalesced flushes (frame pack incl. the native/python codec,
+    coalescing metrics, frame_flush record, frame FIFO bookkeeping).
+    10 remote messages is a fast-path rf=3 write's full egress slice on
+    one node: of the ~14 messages per txn, the coordinator's self-
+    addressed third travels the object-identity loopback and never enters
+    a peer lane."""
+    from accord_tpu.host.tcp import _PeerLane
+    from accord_tpu.messages.wait import WaitOnCommit
+    from accord_tpu.primitives.keys import Route, RoutingKey, RoutingKeys
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    host = _FakeHost()
+    lane = _PeerLane(host, 2)
+    lane.sock = _SinkSock()
+    lane.connecting = False
+    tid = TxnId.create(1, 12345, TxnKind.WRITE, Domain.KEY, 1)
+    msg = WaitOnCommit(tid, Route.of_keys(RoutingKey(11),
+                                          RoutingKeys.of(11, 42)))
+    msg.trace_id = repr(tid)
+    bodies = [{"type": "accord", "msg_id": i, "payload": msg}
+              for i in range(10)]
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for i, body in enumerate(bodies):
+                lane.enqueue(body)
+                if i % 3 == 2:
+                    lane.flush()
+            lane.flush()
+        dt = (time.perf_counter() - t0) / reps * 1e6
+        best = dt if best is None else min(best, dt)
+    assert lane.msgs == 10 * 3 * reps
+    assert not lane.frames_q, "fake socket should have drained every frame"
+    return best
+
+
+def test_egress_buffer_overhead_under_2pct_of_scalar_hot_loop():
+    """ISSUE 8 satellite: the per-txn egress-buffer overhead (coalescer
+    bookkeeping + flight hooks + native frame codec) must stay under 2%
+    of the rf=3 x 1024-entry scalar active-scan hot loop."""
+    egress_us = _egress_txn_bundle_cost_us()
+    loop_us = _scalar_hot_loop_cost_us()
+    ratio = egress_us / loop_us
+    assert ratio < 0.02, (
+        f"egress bundle {egress_us:.1f}us vs scalar hot loop "
+        f"{loop_us:.1f}us per txn: {ratio:.1%} >= 2% budget")
+
+
 # ------------------------------------------------- profiler-off budget ----
 
 def _profiler_off_bundle_cost_us(reps=2000):

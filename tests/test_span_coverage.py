@@ -186,6 +186,21 @@ def test_audit_kinds_are_covered():
             (kind, recorded[kind])
 
 
+def test_frame_coalescing_kinds_are_covered():
+    """The transport egress buffer's forensics hooks must stay on the
+    ring: every message captured into a peer's coalescing buffer
+    (`frame_coalesce`, stamped with the bundled message's PR-2 trace id)
+    and every flushed wire frame (`frame_flush`).  Pinned as a SET like
+    the journal lifecycle below, so a hook cannot vanish together with
+    its EVENT_KINDS row."""
+    recorded = _recorded_flight_kinds()
+    for kind in ("frame_coalesce", "frame_flush"):
+        assert kind in EVENT_KINDS, f"{kind} missing from EVENT_KINDS"
+        assert kind in recorded, f"nothing records {kind}"
+        assert any(p.startswith("host") for p in recorded[kind]), \
+            (kind, recorded[kind])
+
+
 def test_journal_lifecycle_kinds_are_covered():
     """The durable WAL's full lifecycle must stay on the forensics ring:
     append, segment rotation, snapshot compaction, and both replay edges.

@@ -12,6 +12,207 @@ from accord_tpu.host.tcp import TcpHost
 from accord_tpu.sim.verify import Observation, StrictSerializabilityVerifier
 
 
+# ------------------------------------------------ fast event-loop units ----
+
+class _Registryish:
+    def __init__(self):
+        from accord_tpu.obs.registry import Registry
+        self.registry = Registry()
+
+
+class _LaneHost:
+    """The surface _PeerLane touches, with a scriptable socket."""
+
+    my_id = 1
+    flush_tick_us = 0
+
+    def __init__(self):
+        from types import SimpleNamespace
+
+        from accord_tpu.obs.flight import FlightRecorder
+        from accord_tpu.obs.registry import Registry
+        self.flight = FlightRecorder(1, clock_us=lambda: 0)
+        self.node = SimpleNamespace(
+            obs=SimpleNamespace(registry=Registry()))
+        self.peers = {2: ("127.0.0.1", 1)}
+        self.scheduler = SimpleNamespace(once=lambda d, fn: SimpleNamespace(
+            cancel=lambda: None))
+        self.dirty = []
+
+    def mark_dirty(self, lane):
+        self.dirty.append(lane)
+
+    def register(self, sock, events, lane):
+        pass
+
+    def unregister(self, sock):
+        pass
+
+
+class _FlakySock:
+    """Accepts `accept_bytes` then raises like a reset connection."""
+
+    def __init__(self, accept_bytes):
+        self.accept_bytes = accept_bytes
+        self.got = bytearray()
+
+    def send(self, data):
+        if self.accept_bytes <= 0:
+            raise OSError("reset")
+        n = min(self.accept_bytes, len(data))
+        self.got += data[:n]
+        self.accept_bytes -= n
+        return n
+
+    def close(self):
+        pass
+
+
+def _mk_lane(host=None):
+    from accord_tpu.host.tcp import _PeerLane
+    host = host or _LaneHost()
+    return host, _PeerLane(host, 2)
+
+
+def test_peer_lane_reconnect_resends_partial_head_frame_in_order():
+    """Ordering contract: a connection that dies mid-frame must resend the
+    torn head frame IN FULL on the fresh connection (the peer discarded
+    the tail at EOF) — frames never reorder, never silently vanish."""
+    host, lane = _mk_lane()
+    for i in range(3):
+        lane.enqueue({"type": "accord", "msg_id": i, "payload": None})
+        lane.flush()
+    frames = list(lane.frames_q)
+    assert len(frames) == 3
+    # socket accepts 1.5 frames then resets
+    flaky = _FlakySock(len(frames[0]) + len(frames[1]) // 2)
+    lane.sock = flaky
+    lane.connecting = False
+    lane.drain()  # hits the reset mid-frame-1
+    assert lane.sock is None, "broken connection must tear down"
+    # frame 0 fully sent and popped; torn frame 1 still queued FIRST, whole
+    assert list(lane.frames_q) == frames[1:]
+    assert lane.head_off == 0, "torn head frame must resend from byte 0"
+    assert lane.buffered_bytes == sum(len(f) for f in frames[1:])
+    # fresh connection: everything left drains in order
+    good = _FlakySock(1 << 20)
+    lane.sock = good
+    lane.connecting = False
+    lane.drain()
+    assert bytes(good.got) == frames[1] + frames[2]
+    assert not lane.frames_q and lane.buffered_bytes == 0
+
+
+def test_peer_lane_dead_peer_drops_whole_frames_and_keeps_probing():
+    """A peer that outlives the whole backoff schedule loses buffered
+    frames WHOLE (send_drops counted; lossy-link model) and the lane keeps
+    probing at the backoff cap so a restarted peer is rediscovered."""
+    host, lane = _mk_lane()
+    lane.enqueue({"type": "accord", "msg_id": 1, "payload": None})
+    lane.flush()
+    drops_before = lane.send_drops
+    for _ in range(lane.backoff.max_attempts + 2):
+        lane.sock = _FlakySock(0)
+        lane.connecting = False
+        lane.drain()
+    assert lane.send_drops > drops_before
+    assert not lane.frames_q and lane.buffered_bytes == 0
+    assert lane.retries > 0
+
+
+def test_peer_lane_coalesces_pending_into_one_frame():
+    """Everything pending at a flush tick leaves as ONE multi-message
+    frame, decoded back into the individual bodies on the far side."""
+    from accord_tpu.host.wire import unpack_frame
+    host, lane = _mk_lane()
+    for i in range(5):
+        lane.enqueue({"type": "accord", "msg_id": i, "payload": None})
+    lane.flush()
+    assert len(lane.frames_q) == 1 and lane.frames == 1 and lane.msgs == 5
+    packed = bytes(lane.frames_q[0])
+    import struct
+    (n,) = struct.unpack_from(">I", packed)
+    frame = unpack_frame(packed[4:4 + n])
+    assert frame["src"] == 1
+    assert [b["msg_id"] for b in frame["m"]] == list(range(5))
+    # coalescing obs: ratio surfaces in the summarize() transport section
+    from accord_tpu.obs.report import summarize
+    section = summarize(host.node.obs.registry.snapshot())["transport"]
+    assert section["frames"] == 1 and section["msgs"] == 5
+    assert section["coalesce_ratio"] == 5.0
+
+
+def test_inconn_parses_split_and_multi_frames():
+    """The incremental length-prefix parser handles frames arriving split
+    across arbitrary read boundaries."""
+    import struct
+
+    from accord_tpu.host.tcp import _InConn
+    from accord_tpu.host.wire import pack_frame
+
+    frames = [{"src": 0, "body": {"type": "submit", "req": i}}
+              for i in range(3)]
+    stream = b"".join(
+        struct.pack(">I", len(p)) + p
+        for p in (pack_frame(f) for f in frames))
+
+    class _ChunkSock:
+        def __init__(self, data, chunk):
+            self.data = data
+            self.chunk = chunk
+
+        def recv(self, n):
+            if not self.data:
+                raise BlockingIOError
+            out = self.data[:self.chunk]
+            self.data = self.data[self.chunk:]
+            return out
+
+    got = []
+    conn = _InConn(_ChunkSock(stream, 7))
+    while True:
+        out = conn.read_frames()
+        assert out is not None
+        got.extend(out)
+        if len(got) == 3:
+            break
+    assert [f["body"]["req"] for f in got] == [0, 1, 2]
+
+
+def test_run_loop_runs_due_timers_before_blocking():
+    """ISSUE 8 satellite (timer latency bug): a due-now scheduler deadline
+    must run before the loop blocks — the old `min(timeout, 0.2) or 0.01`
+    turned timeout==0.0 into a 10ms sleep per due timer."""
+    import time as _time
+
+    # chain of 30 immediately-due timers, each firing scheduling the next:
+    # under the old floor this cost >= 30 * 10ms; the event loop runs due
+    # timers before every block, so the chain completes ~instantly
+    host = TcpHost(1, {1: ("127.0.0.1", 0)}, rf=1, n_shards=1)
+    try:
+        host.scheduler.once(0.0, lambda: None)  # warm
+        t0 = _time.monotonic()
+        done = []
+
+        def chain(n=30):
+            if n == 0:
+                done.append(_time.monotonic())
+                return
+            host.scheduler.once(0.0, lambda: chain(n - 1))
+
+        host.call_soon(chain)
+        deadline = _time.monotonic() + 5.0
+        while not done and _time.monotonic() < deadline:
+            _time.sleep(0.005)
+        assert done, "timer chain did not complete"
+        elapsed = done[0] - t0
+        assert elapsed < 0.15, (
+            f"30 chained due-now timers took {elapsed * 1e3:.0f}ms — the "
+            f"due-timer floor is back")
+    finally:
+        host.close()
+
+
 @pytest.mark.slow
 def test_three_node_tcp_cluster_strict_serializable():
     ports = {1: ("127.0.0.1", 0), 2: ("127.0.0.1", 0), 3: ("127.0.0.1", 0)}

@@ -338,6 +338,124 @@ def test_round_trip_preserves_trace_id(harvested):
         assert decoded.trace_id == msg.trace_id
 
 
+def _codec_tiers():
+    """(python_pack, native_pack_or_None, native_unpack, native_unpack_obj)
+    — the cross-check below pins the two pack tiers byte-identical and the
+    one-pass object decode canonically equal to tree decode."""
+    from accord_tpu import native
+    from accord_tpu.host import wire
+    mod = native.get_wire()
+    if mod is None:
+        return wire.py_pack, None, None, None
+    wire._native_codec()  # ensure wire_bind ran (arms the object packer)
+    return wire.py_pack, mod.wire_pack, mod.wire_unpack, mod.wire_unpack_obj
+
+
+def _coalesced_frame(msgs):
+    """One transport-level multi-message frame exactly as host/tcp.py's
+    egress buffer builds it: every verb a flush tick produced for one
+    peer, bodies carrying the RAW message objects (binary wire modes)."""
+    bodies = []
+    for i, msg in enumerate(msgs):
+        body = {"type": "accord", "payload": msg}
+        if i % 3 == 0:
+            body["msg_id"] = 1000 + i
+        elif i % 3 == 1:
+            body["in_reply_to"] = 2000 + i
+        bodies.append(body)
+    return {"src": 1, "m": bodies}
+
+
+def test_coalesced_envelope_frame_round_trips_every_verb(harvested):
+    """ISSUE 8 satellite: a coalesced multi-verb frame containing EVERY
+    registered verb round-trips through both the Python and native frame
+    codecs — identical bytes from both pack tiers, and the native
+    one-pass object decode (wire_unpack_obj) yields canonically identical
+    messages to the tree path (unpack + decode_message).  Coverage is
+    asserted: the envelope must actually carry the whole registry."""
+    from accord_tpu.host import wire
+
+    py_pack, nat_pack, nat_unpack, nat_unpack_obj = _codec_tiers()
+    # one instance of every verb: harvest first, synthesizers for the rest
+    by_verb = {}
+    for msg in harvested:
+        mt = getattr(msg, "type", None)
+        if mt is not None and mt.name not in by_verb:
+            by_verb[mt.name] = msg
+    for msg in _synthesize(_Gen(4000)):
+        by_verb.setdefault(msg.type.name, msg)
+    want = {mt.name for mt in MessageType} - UNEMITTED
+    missing = sorted(want - set(by_verb))
+    assert not missing, f"envelope coverage gap: {missing}"
+
+    msgs = [by_verb[name] for name in sorted(want)]
+    frame = _coalesced_frame(msgs)
+    out = bytearray()
+    wire._py_pack_value(frame, out)
+    py_bytes = bytes(out)
+    if nat_pack is not None:
+        nat_bytes = nat_pack(frame)
+        assert nat_bytes == py_bytes, \
+            "python and native frame packs diverged on the envelope"
+        # native one-pass object decode == tree decode, per bundled verb
+        obj_frame = nat_unpack_obj(py_bytes)
+        tree_frame = nat_unpack(py_bytes)
+    else:
+        obj_frame = None
+        tree_frame = wire.py_unpack(py_bytes)
+    assert len(tree_frame["m"]) == len(msgs)
+    for i, body in enumerate(tree_frame["m"]):
+        decoded = decode_message(body["payload"])
+        assert type(decoded) is type(msgs[i])
+        assert canonical_encoding(decoded) == canonical_encoding(msgs[i])
+        if obj_frame is not None:
+            obj = obj_frame["m"][i]["payload"]
+            assert type(obj) is type(msgs[i])
+            assert canonical_encoding(obj) == canonical_encoding(msgs[i])
+    # the reply-context plumbing survives untouched
+    assert tree_frame["m"][0]["msg_id"] == 1000
+    assert tree_frame["m"][1]["in_reply_to"] == 2001
+
+
+def test_pack_tiers_byte_identical_over_harvest(harvested):
+    """Every harvested message packs to IDENTICAL bytes through the
+    pure-Python tier and the native one-pass object packer — the
+    interoperability contract between hosts on different tiers."""
+    from accord_tpu.host import wire
+
+    py_pack, nat_pack, nat_unpack, _ = _codec_tiers()
+    if nat_pack is None:
+        import pytest
+        pytest.skip("native wire codec unavailable (no toolchain)")
+    checked = 0
+    for msg in harvested[:300]:
+        body = {"src": 2, "body": {"type": "accord", "msg_id": 7,
+                                   "payload": msg}}
+        out = bytearray()
+        wire._py_pack_value(body, out)
+        nat = nat_pack(body)
+        assert nat == bytes(out), type(msg).__name__
+        # and both tiers' bytes decode back canonically
+        tree = nat_unpack(nat)
+        decoded = decode_message(tree["body"]["payload"])
+        assert canonical_encoding(decoded) == canonical_encoding(msg)
+        checked += 1
+    assert checked
+
+
+def test_frame_codec_json_autodetect():
+    """Legacy JSON frames (hand-written harness clients) still decode:
+    unpack auto-detects by leading byte."""
+    from accord_tpu.host.wire import pack_frame, unpack_frame
+
+    frame = {"src": 0, "body": {"type": "submit", "req": 1,
+                                "reads": [5], "appends": {"5": 1}}}
+    assert unpack_frame(json.dumps(frame).encode()) == frame
+    binary = pack_frame(frame)
+    assert binary[:1] != b"{"
+    assert unpack_frame(binary) == frame
+
+
 def test_journal_record_codec_round_trips(harvested):
     """The WAL's record codec (wire JSON + framing) over harvested
     traffic: encode_record -> decode_record -> canonical equality."""
