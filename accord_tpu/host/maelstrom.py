@@ -155,6 +155,7 @@ class MaelstromHost:
         self.pipeline = None  # built with the node when ACCORD_PIPELINE=1
         self.metrics_server = None  # built with the node (obs/httpd)
         self.auditor = None         # built with the node (local/audit.py)
+        self.loop_health = None     # built with the node (obs/cpuprof.py)
         self.node_name = ""
         self.names: Dict[int, str] = {}
         self.scheduler = RealTimeScheduler()
@@ -194,6 +195,14 @@ class MaelstromHost:
                          ListStore(my_id), RandomSource(my_id),
                          num_shards=1,
                          now_us=lambda: int(time.time() * 1e6))
+        # always-on event-loop health telemetry, same layer the TCP loop
+        # wires (obs/cpuprof.LoopHealth): the PR-8 due-timer fix gave this
+        # loop correct timer scheduling but no way to OBSERVE timer
+        # lateness — the lag histogram closes that
+        from accord_tpu.obs.cpuprof import LoopHealth
+        self.loop_health = LoopHealth(self.node.obs.registry,
+                                      self.node.obs.flight)
+        self.scheduler.lag_observer = self.loop_health.timer_lag
         self.node.on_topology_update(topology)
         # ACCORD_JOURNAL=<dir>: replay surviving state from
         # <dir>/node-<id>, then journal every side-effecting request before
@@ -304,7 +313,15 @@ class MaelstromHost:
             self.node.coordinate(txn).add_callback(done)
 
     def _handle_accord(self, src: str, body: dict) -> None:
-        payload = decode_message(body["payload"])
+        prof = self.node.obs.cpuprof
+        if prof.enabled:
+            # decode lap for the CPU waterfall (obs/cpuprof.py): parked on
+            # the profiler, consumed by the dispatch it precedes
+            t0 = time.perf_counter()
+            payload = decode_message(body["payload"])
+            prof.note_decode(time.perf_counter() - t0)
+        else:
+            payload = decode_message(body["payload"])
         from_id = node_num(src)
         if "in_reply_to" in body:
             self.sink.deliver_reply(body["in_reply_to"], from_id, payload)
@@ -351,6 +368,7 @@ class MaelstromHost:
             coalesce = self.pipeline is not None and len(batch) > 1
             if coalesce:
                 self.sink.batch_begin()
+            t_busy = time.perf_counter()
             try:
                 for line in batch:
                     if line is None:
@@ -366,6 +384,13 @@ class MaelstromHost:
                 if coalesce:
                     self.sink.batch_flush()
             self.scheduler.run_due()
+            if batch and self.loop_health is not None:
+                # loop-health parity with the TCP event loop
+                # (obs/cpuprof.LoopHealth): busy time of this pass (the
+                # blocking stdin get excluded), burst length, and the
+                # stdin backlog left unread — the saturation signal
+                self.loop_health.tick(time.perf_counter() - t_busy,
+                                      len(batch), lines.qsize())
         if self.wal is not None:
             self.wal.close()  # final fsync on clean shutdown
 

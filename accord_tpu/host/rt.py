@@ -40,6 +40,11 @@ class RealTimeScheduler(Scheduler):
         self.on_error: Callable = on_error if on_error is not None else (
             lambda e: print(f"timer error: {e!r}", file=sys.stderr,
                             flush=True))
+        # loop-health hook (obs/cpuprof.LoopHealth.timer_lag): called with
+        # (now - deadline) seconds for every due timer run — the
+        # scheduled-vs-actual fire delta that makes loop saturation
+        # measurable.  None (the default) costs one attribute check.
+        self.lag_observer: Optional[Callable[[float], None]] = None
 
     def once(self, delay_s: float, fn: Callable[[], None]) -> TimerHandle:
         h = TimerHandle()
@@ -80,6 +85,7 @@ class RealTimeScheduler(Scheduler):
     def run_due(self, limit: int = 1000) -> int:
         ran = 0
         now = time.monotonic()
+        observer = self.lag_observer
         while self._heap and ran < limit:
             deadline, _, handle, fn = self._heap[0]
             if deadline > now:
@@ -87,6 +93,8 @@ class RealTimeScheduler(Scheduler):
             heapq.heappop(self._heap)
             if handle.cancelled:
                 continue
+            if observer is not None:
+                observer(now - deadline)
             try:
                 fn()
             except Exception as e:  # noqa: BLE001

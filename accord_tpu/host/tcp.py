@@ -555,6 +555,12 @@ class TcpHost:
                          store_factory=_env_store_factory(),
                          now_us=lambda: int(time.time() * 1e6))
         self.flight = self.node.obs.flight
+        # always-on event-loop health telemetry (obs/cpuprof.LoopHealth):
+        # timer-lag histogram via the scheduler hook, tick/burst/backlog
+        # gauges from _tick, loop_lag/queue_saturation flight alarms
+        from accord_tpu.obs.cpuprof import LoopHealth
+        self.loop_health = LoopHealth(self.node.obs.registry, self.flight)
+        self.scheduler.lag_observer = self.loop_health.timer_lag
         self.node.on_topology_update(topology)
 
         # ACCORD_JOURNAL=<dir>: durable write-ahead journal under
@@ -718,12 +724,14 @@ class TcpHost:
         self._dirty = keep
 
     def _tick(self) -> None:
+        t_start = time.perf_counter()
         # 1. due timers run BEFORE blocking: a due-now deadline must never
         #    be floored into a sleep (the old loop's `or 0.01` cost 10ms
         #    of timer latency exactly when a deadline was already due).
         #    Timers emit too (RPC timeouts, pipeline batch dispatch):
         #    flush what they produced.
-        if self.scheduler.run_due():
+        ran_timers = self.scheduler.run_due()
+        if ran_timers:
             self._flush_due()
 
         # 2. cross-thread calls (submits, WAL-released replies)
@@ -742,10 +750,12 @@ class TcpHost:
         timeout = self._poll_timeout(work)
         if timeout > 0.0 and self._dirty:
             self._flush_all()
+        busy_pre = time.perf_counter() - t_start
         try:
             events = self.selector.select(timeout)
         except OSError:
             return  # selector torn down under us during shutdown
+        t_resume = time.perf_counter()
 
         # 4. IO: collect every complete inbound frame this pass produced
         #    (plus deferred loopback deliveries), then dispatch the burst
@@ -795,6 +805,14 @@ class TcpHost:
             if coalesce:
                 self.sink.batch_flush()
         self._flush_due()
+        if items or ran_timers or work:
+            # loop health: busy time (blocking poll excluded), burst
+            # length, and the backlog this pass left undrained — the
+            # saturation signal (obs/cpuprof.LoopHealth); idle passes
+            # record nothing
+            self.loop_health.tick(
+                busy_pre + (time.perf_counter() - t_resume), len(items),
+                len(self._calls) + len(self._local_q))
 
     def _flush_all(self) -> None:
         dirty, self._dirty = self._dirty, []
@@ -894,6 +912,16 @@ class TcpHost:
                                     "req": body.get("req"),
                                     "node": self.my_id, "audit": view})
             return
+        if kind == "top":
+            # live protocol-CPU waterfall + loop health over the frame
+            # transport (obs/cpuprof.py; the same data the metrics
+            # endpoint serves at /top); client-endpoint src only
+            if from_id <= 0:
+                self.emit(from_id, {"type": "top_reply",
+                                    "req": body.get("req"),
+                                    "node": self.my_id,
+                                    "top": self.node.obs.cpu_view()})
+            return
         if kind == "stop":
             # accept stop only from harness/client frames (non-positive
             # declared src).  NOTE: src is self-declared — this guards
@@ -907,8 +935,17 @@ class TcpHost:
         payload = body["payload"]
         if type(payload) is dict:
             # tree payload (JSON frame or Python-tier unpack): decode here;
-            # the native ingress already delivered the message object
-            payload = decode_message(payload)
+            # the native ingress already delivered the message object.
+            # Under ACCORD_CPU_PROFILE the decode lap is parked on the
+            # profiler so the next dispatch attributes it (the native
+            # tier's frame-level unpack shows in the loop tick gauge)
+            prof = self.node.obs.cpuprof
+            if prof.enabled:
+                t0 = time.perf_counter()
+                payload = decode_message(payload)
+                prof.note_decode(time.perf_counter() - t0)
+            else:
+                payload = decode_message(payload)
         if "in_reply_to" in body:
             self.sink.deliver_reply(body["in_reply_to"], from_id, payload)
         else:
@@ -1162,6 +1199,25 @@ class TcpClusterClient:
             body = got.get("body", {})
             if body.get("type") == "audit_reply" and body.get("req") == req:
                 return body.get("audit")
+        return None
+
+    def fetch_top(self, to: int, timeout_s: float = 15.0) -> Optional[dict]:
+        """Pull node `to`'s protocol-CPU top-verbs waterfall + loop-health
+        view over the frame transport (same quiet-channel caveat as
+        fetch_metrics)."""
+        req = f"top-{to}"
+        try:
+            self._send(to, {"type": "top", "req": req})
+        except OSError:
+            return None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = self.recv(min(1.0, timeout_s))
+            if got is None:
+                continue
+            body = got.get("body", {})
+            if body.get("type") == "top_reply" and body.get("req") == req:
+                return body.get("top")
         return None
 
     def close(self) -> None:

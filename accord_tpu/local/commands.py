@@ -77,8 +77,15 @@ def calculate_deps(safe_store: SafeCommandStore, txn_id: TxnId, participants,
             for r in overlap:
                 rbuilder.add(r, dep)
 
+    # cfk stage fence (obs/cpuprof.py): the active-conflict scan is the
+    # per-key conflict-index walk PAPER.md singles out as the hot kernel —
+    # attribute it separately from the rest of the apply
+    prof = safe_store.store.cpuprof
+    t = prof.stage_begin() if prof is not None and prof.active else None
     safe_store.map_reduce_active(participants, before, kinds, visit,
                                  on_range_dep=visit_range, exclude=txn_id)
+    if t is not None:
+        prof.stage_end(t, "cfk")
     return Deps(builder.build(), rbuilder.build())
 
 

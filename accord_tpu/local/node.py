@@ -495,6 +495,16 @@ class Node:
         self.obs.flight.record(
             "reply", None,
             (to, mt.label if mt is not None else type(reply).__name__))
+        prof = self.obs.cpuprof
+        if prof.active:
+            # inside a sampled dispatch (obs/cpuprof.py): the sink's encode
+            # + egress work is the "reply_encode" stage of the waterfall.
+            # (Binary-tier TCP packs at flush time, outside the dispatch —
+            # that cost shows in the loop tick gauge instead.)
+            t = prof.stage_begin()
+            self.sink.reply(to, reply_context, reply)
+            prof.stage_end(t, "reply_encode")
+            return
         self.sink.reply(to, reply_context, reply)
 
     def receive(self, request: Request, from_id: int, reply_context) -> None:
@@ -533,6 +543,12 @@ class Node:
         if self.journal is not None and request.type is not None \
                 and request.type.has_side_effects:
             self.journal.record(self.id, request)
+        # protocol-CPU attribution (obs/cpuprof.py, ACCORD_CPU_PROFILE=N):
+        # bracket the dispatch so its wall time decomposes into the
+        # decode/apply/cfk/reply-encode waterfall, labeled by verb.  With
+        # profiling off this is ONE attribute check (obs-budget-gated).
+        prof = self.obs.cpuprof
+        sampled = prof.enabled and prof.dispatch_begin(verb)
         try:
             request.process(self, from_id, reply_context)
         except BaseException as e:  # noqa: BLE001
@@ -540,6 +556,9 @@ class Node:
                 self.reply(from_id, reply_context, FailureReply(e))
             else:
                 self.agent.on_uncaught_exception(e)
+        finally:
+            if sampled:
+                prof.dispatch_end()
 
     def local_request(self, request: Request) -> None:
         """Apply a local-only request (PROPAGATE_*) to our own stores."""

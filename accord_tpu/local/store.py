@@ -161,11 +161,20 @@ class SafeCommandStore:
         if status.has_info:
             deps = command.stable_deps if command.stable_deps is not None \
                 else command.partial_deps
+        prof = self.store.cpuprof
         for key in self.owned_keys_of(command):
             dep_ids = deps.key_deps.txn_ids_for_key(key) \
                 if deps is not None else None
+            # cfk stage fence (obs/cpuprof.py): the conflict-index update
+            # is timed per key; fired Unmanaged callbacks run OUTSIDE the
+            # fence (they are execution work, not index maintenance) and
+            # keep their per-key interleaving
+            t = prof.stage_begin() if prof is not None and prof.active \
+                else None
             fired = self.cfk(key).update(command.txn_id, status,
                                          command.execute_at, dep_ids=dep_ids)
+            if t is not None:
+                prof.stage_end(t, "cfk")
             for u in fired:
                 u.callback(self)
 
@@ -496,6 +505,12 @@ class CommandStore:
         # per-txn count of failed catch-ups where every peer had truncated
         # the deps (Propagate INSUFFICIENT): drives staleness escalation
         self.insufficient_catchups: Dict[TxnId, int] = {}
+        # the owning node's protocol-CPU profiler (obs/cpuprof.py), cached
+        # so the per-key CFK fences in register/calculate_deps cost one
+        # attribute check when profiling is off; None on bare-store
+        # harnesses whose node stub carries no obs facade
+        obs = getattr(node, "obs", None)
+        self.cpuprof = getattr(obs, "cpuprof", None)
 
     # -- environment plumbing --
     @property

@@ -22,6 +22,10 @@ with one process-local layer:
     and the flush-window waterfall (sampled via `ACCORD_PROFILE=N`, off
     by default; fences are injected by the device layer so this package
     stays jax-free);
+  * `cpuprof` — the protocol-tier CPU attribution profiler (sampled
+    per-dispatch decode/apply/cfk/reply-encode waterfall, labeled by
+    verb, `ACCORD_CPU_PROFILE=N`, off by default) and the always-on
+    event-loop health gauges (`LoopHealth`) the wall-clock hosts wire;
   * `node_obs.NodeObs` — the per-Node facade the engine instruments
     against (one registry + one span store + one flight ring per node);
   * `httpd` — the Prometheus-style text endpoint (`ACCORD_METRICS_PORT`)
@@ -35,6 +39,9 @@ jitted code.  tests/test_obs_budget.py enforces this plus a <5% overhead
 bound on the scalar hot loop.
 """
 
+from accord_tpu.obs.cpuprof import (CpuProfiler, LoopHealth,
+                                    cpu_profiler_from_env,
+                                    merge_cpu_exports)
 from accord_tpu.obs.flight import (EVENT_KINDS, FlightRecorder,
                                    first_divergence, format_timeline,
                                    stitch_flight, trace_ids_in_text)
@@ -47,9 +54,11 @@ from accord_tpu.obs.spans import (SpanStore, find_trace_ids, stitch,
 from accord_tpu.obs.views import CounterDict, MetricView, bind_metric_views
 
 __all__ = [
-    "Counter", "CounterDict", "EVENT_KINDS", "FlightRecorder", "Gauge",
-    "Histogram", "MetricView", "NodeObs", "Profiler", "Registry",
-    "SpanStore", "bind_metric_views", "find_trace_ids", "first_divergence",
-    "format_timeline", "parse_labels", "profiler_from_env", "stitch",
-    "stitch_flight", "trace_ids_in_text", "trace_key",
+    "Counter", "CounterDict", "CpuProfiler", "EVENT_KINDS",
+    "FlightRecorder", "Gauge", "Histogram", "LoopHealth", "MetricView",
+    "NodeObs", "Profiler", "Registry", "SpanStore", "bind_metric_views",
+    "cpu_profiler_from_env", "find_trace_ids", "first_divergence",
+    "format_timeline", "merge_cpu_exports", "parse_labels",
+    "profiler_from_env", "stitch", "stitch_flight", "trace_ids_in_text",
+    "trace_key",
 ]

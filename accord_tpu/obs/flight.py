@@ -87,6 +87,14 @@ EVENT_KINDS = {
                       "message's; data=(peer, pending_in_buffer)",
     "frame_flush": "per-peer egress buffer left as ONE coalesced wire "
                    "frame (host/tcp.py); data=(peer, messages, bytes)",
+    "loop_lag": "event-loop timer fired later than its deadline by more "
+                "than the alarm threshold (obs/cpuprof.LoopHealth, wired "
+                "by host/tcp.py and host/maelstrom.py; rate-limited); "
+                "data=(lag_us,)",
+    "queue_saturation": "event-loop backlog crossed the saturation "
+                        "threshold (obs/cpuprof.LoopHealth, wired by "
+                        "host/tcp.py and host/maelstrom.py; edge-"
+                        "triggered); data=(depth,)",
 }
 
 

@@ -8,6 +8,8 @@
     GET /flight?txn=ID  one trace id's flight events on this node, JSON
     GET /audit          live replica-state auditor view (divergences,
                         last digest round, lifecycle census), JSON
+    GET /top            protocol-CPU top-verbs waterfall + event-loop
+                        health gauges (obs/cpuprof.py), JSON
 
 Multi-process clusters on one machine offset the base port by the node id
 (node N binds base + N - 1); base 0 binds an ephemeral port (recorded on
@@ -49,6 +51,12 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps({"node": obs.node_id, "txn": txn,
                                "recorded_total": flight.recorded_total,
                                "events": [list(e) for e in events]}).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/top"):
+            # protocol-CPU waterfall + loop health (obs/cpuprof.py): the
+            # per-verb top table is live when ACCORD_CPU_PROFILE=N is set;
+            # the loop gauges are always-on
+            body = json.dumps(obs.cpu_view()).encode()
             ctype = "application/json"
         elif self.path.startswith("/audit"):
             # live replica-state auditor view (divergences, last digest

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from accord_tpu.obs.cpuprof import cpu_profiler_from_env
 from accord_tpu.obs.flight import FlightRecorder
 from accord_tpu.obs.registry import Registry
 from accord_tpu.obs.spans import (PHASE_ORDER, SpanStore, phase_deltas,
@@ -29,7 +30,7 @@ class NodeObs:
     """Per-node metrics registry + span store + instrumentation helpers."""
 
     __slots__ = ("node_id", "registry", "spans", "flight", "enabled",
-                 "_clock_us", "audit_view")
+                 "_clock_us", "audit_view", "cpuprof")
 
     def __init__(self, node_id: int = 0, registry: Optional[Registry] = None,
                  clock_us: Optional[Callable[[], int]] = None,
@@ -49,6 +50,10 @@ class NodeObs:
         # /audit route and host "audit" frames can serve it; None when no
         # auditor is attached
         self.audit_view: Optional[Callable[[], dict]] = None
+        # protocol-tier CPU attribution (obs/cpuprof.py): sampled
+        # per-dispatch decode/apply/cfk/reply-encode waterfall, labeled by
+        # verb — off unless ACCORD_CPU_PROFILE=N is set
+        self.cpuprof = cpu_profiler_from_env(self.registry)
 
     def now_us(self) -> int:
         return int(self._clock_us())
@@ -124,8 +129,24 @@ class NodeObs:
     # ------------------------------------------------------------ export --
     def snapshot(self) -> dict:
         """JSON-safe per-node snapshot (the wire/bench/burn interchange
-        format; merge with obs.report.merge_node_snapshots)."""
+        format; merge with obs.report.merge_node_snapshots).  When the
+        protocol-CPU profiler has samples, they ride as the "cpu" key so
+        the cross-node merge can compute exact-sample quantiles."""
         from accord_tpu.obs.report import summarize
+        cpu = self.cpuprof.export()
         metrics = self.registry.snapshot()
-        return {"node": self.node_id, "metrics": metrics,
-                "summary": summarize(metrics)}
+        snap = {"node": self.node_id, "metrics": metrics,
+                "summary": summarize(metrics, cpu=cpu)}
+        if cpu is not None:
+            snap["cpu"] = cpu
+        return snap
+
+    def cpu_view(self) -> dict:
+        """The live protocol-CPU + loop-health view (httpd `GET /top`, the
+        tcp host's "top" frame, `burn --cpu-top`): this node's per-verb
+        waterfall and top-verbs table plus the event-loop health gauges."""
+        from accord_tpu.obs.report import cpu_section, loop_section
+        metrics = self.registry.snapshot()
+        return {"node": self.node_id,
+                "cpu": cpu_section(self.cpuprof.export()),
+                "loop": loop_section(metrics)}

@@ -17,17 +17,21 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from accord_tpu.obs.cpuprof import merge_cpu_exports
 from accord_tpu.obs.registry import (merge_snapshots, parse_labels,
                                      snapshot_quantile)
 
 
 def merge_node_snapshots(snaps: List[dict]) -> dict:
     """Merge NodeObs.snapshot() dicts from several nodes/processes into one
-    cluster view: {"nodes": [...], "metrics": merged, "summary": ...}."""
+    cluster view: {"nodes": [...], "metrics": merged, "summary": ...}.
+    Protocol-CPU raw samples ("cpu" key, obs/cpuprof.py) pool across nodes
+    so the summary's exact-sample per-verb quantiles stay exact."""
     snaps = [s for s in snaps if s]
     metrics = merge_snapshots([s.get("metrics", {}) for s in snaps])
+    cpu = merge_cpu_exports([s.get("cpu") for s in snaps])
     return {"nodes": [s.get("node") for s in snaps], "metrics": metrics,
-            "summary": summarize(metrics)}
+            "summary": summarize(metrics, cpu=cpu)}
 
 
 def _counter_by_label(metrics: dict, name: str, label: str) -> Dict[str, int]:
@@ -186,7 +190,68 @@ def slo_report(open_samples_us, closed_samples_us,
     return report
 
 
-def summarize(metrics: dict) -> dict:
+# ------------------------------------------------------------ CPU rows ----
+
+# how many verbs the "top verbs by total CPU" table keeps
+_CPU_TOP_N = 10
+
+
+def cpu_section(cpu: Optional[dict]) -> dict:
+    """The protocol-CPU waterfall summary (tentpole of ISSUE 9): per-verb
+    exact-sample p50/p99 of the per-dispatch total plus per-(verb, stage)
+    quantiles, and the top-verbs-by-total-CPU table.  `cpu` is a (possibly
+    cross-node pooled) CpuProfiler export; estimated totals scale each
+    verb's sampled mean by its FULL dispatch census, so 1-in-N sampling
+    does not skew the ranking.  Exact-sample quantiles only — the log2
+    buckets stay for always-on monitoring, but a `--guard` gate needs
+    sample-exact numbers (the PR-3 precedent)."""
+    section = {"quantile_source": "exact-sample", "sampled": 0,
+               "dispatches": 0, "verbs": {}, "top": []}
+    if not cpu:
+        return section
+    section["sampled"] = cpu.get("sampled", 0)
+    dispatches = cpu.get("dispatches", {})
+    section["dispatches"] = sum(dispatches.values())
+    verbs = {}
+    grand_ms = 0.0
+    for verb, samples in sorted(cpu.get("totals", {}).items()):
+        if not samples:
+            continue
+        q = exact_quantiles_us(samples)
+        n_disp = dispatches.get(verb, q["count"])
+        est_ms = round(q["mean_us"] * n_disp / 1e3, 2)
+        grand_ms += est_ms
+        stages = {st: exact_quantiles_us(ss) for st, ss in
+                  sorted(cpu.get("stages", {}).get(verb, {}).items()) if ss}
+        verbs[verb] = dict(q, dispatches=n_disp, est_total_ms=est_ms,
+                           stages=stages)
+    section["verbs"] = verbs
+    top = sorted(((v, d["est_total_ms"]) for v, d in verbs.items()),
+                 key=lambda x: -x[1])[:_CPU_TOP_N]
+    section["top"] = [[v, ms, round(ms / grand_ms, 4) if grand_ms else 0.0]
+                      for v, ms in top]
+    return section
+
+
+def loop_section(metrics: dict) -> dict:
+    """Event-loop health (obs/cpuprof.LoopHealth, always-on in the
+    wall-clock hosts): timer lag, tick busy time, dispatch-burst shape,
+    high-water backlog, and alarm counts."""
+    return {
+        "lag_us": _hist_report(_merged_hist(metrics, "accord_loop_lag_us")),
+        "tick_us": _hist_report(_merged_hist(metrics,
+                                             "accord_loop_tick_us")),
+        "burst_msgs": _hist_report(_merged_hist(metrics,
+                                                "accord_loop_burst_msgs")),
+        "depth_max": _gauge_max(metrics, "accord_loop_depth_max"),
+        "lag_alarms": _counter_total(metrics,
+                                     "accord_loop_lag_alarms_total"),
+        "saturation_alarms": _counter_total(
+            metrics, "accord_loop_queue_saturation_total"),
+    }
+
+
+def summarize(metrics: dict, cpu: Optional[dict] = None) -> dict:
     paths = _counter_by_label(metrics, "accord_path_total", "path")
     fast = paths.get("fast", 0)
     slow = paths.get("slow", 0)
@@ -257,6 +322,8 @@ def summarize(metrics: dict) -> dict:
             "retries": _counter_total(metrics,
                                       "accord_tcp_peer_retries_total"),
         },
+        "cpu": cpu_section(cpu),
+        "loop": loop_section(metrics),
         "infer": _infer_section(metrics),
         "audit": {
             # replica-state auditor (local/audit.py): digest-round

@@ -645,6 +645,11 @@ def main(argv=None) -> int:
     parser.add_argument("--metrics", action="store_true",
                         help="print the end-of-run obs report (merged "
                              "metrics registry summary, JSON)")
+    parser.add_argument("--cpu-top", action="store_true",
+                        help="print the merged protocol-CPU waterfall "
+                             "(per-verb stage p50/p99 + top-verbs table, "
+                             "obs/cpuprof.py; set ACCORD_CPU_PROFILE=N "
+                             "to sample, else the section is empty)")
     parser.add_argument("--flight-dump", action="store_true",
                         help="print the stitched cross-replica flight-"
                              "recorder tail after the run (the same view "
@@ -791,6 +796,10 @@ def main(argv=None) -> int:
         if args.metrics:
             import json as _json
             print("obs " + _json.dumps(run.metrics_snapshot()["summary"]))
+        if args.cpu_top:
+            import json as _json
+            print("cpu " + _json.dumps(
+                run.metrics_snapshot()["summary"]["cpu"]))
         if args.flight_dump:
             from accord_tpu.obs.flight import format_timeline
             tids = None

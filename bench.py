@@ -104,6 +104,11 @@ def _regression_guard(result: dict) -> None:
             # p50/p99/p99.9 overall and per phase — `--guard` gates the
             # tails, not just the headline throughput
             entry["slo"] = result["slo"]
+        if "cpu" in result:
+            # protocol-CPU waterfall (obs/cpuprof.py): per-(verb, stage)
+            # exact-sample p50/p99 + the top-verbs table — `--guard`
+            # gates per-verb p50 regressions like per-kernel p50s
+            entry["cpu"] = result["cpu"]
         for key in ("per_procs", "cpus_available",
                     "scaling_first_to_last"):
             # multicore lane: the per-process-count scaling table IS the
@@ -837,6 +842,15 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16,
     from accord_tpu.sim.verify import (Observation,
                                        StrictSerializabilityVerifier)
 
+    # guard tests shrink the lane (ACCORD_BENCH_TCP_OPS/_KEYS); the
+    # protocol-CPU waterfall samples 1-in-2 dispatches in every node
+    # process so the row always carries the per-verb "cpu" section
+    # (overridable; the hooks are a handful of clock reads per sampled
+    # dispatch vs ~100us+ applies, so the lane's numbers are unaffected)
+    n_ops = int(os.environ.get("ACCORD_BENCH_TCP_OPS", n_ops))
+    keys = int(os.environ.get("ACCORD_BENCH_TCP_KEYS", keys))
+    os.environ.setdefault("ACCORD_CPU_PROFILE", "2")
+
     rng = random.Random(seed)
     c = TcpClusterClient(n_nodes=nodes)
     obs = []
@@ -928,6 +942,11 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16,
     finally:
         c.close()
     assert acked > 0.9 * n_ops, (acked, completed)
+    cpu_summary = None
+    if obs_summary is not None:
+        # the protocol-CPU waterfall is its own top-level row key (the
+        # `--guard` per-verb gate's input), not buried in obs
+        cpu_summary = obs_summary.pop("cpu", None)
     result = {
         "metric": metric,
         "value": round(acked / dt, 1),
@@ -943,6 +962,8 @@ def bench_tcp(nodes=3, keys=100, n_ops=400, seed=7, pipeline=16,
     }
     if obs_summary is not None:
         result["obs"] = obs_summary
+    if cpu_summary is not None and cpu_summary.get("sampled"):
+        result["cpu"] = cpu_summary
     if extra_fields:
         result.update(extra_fields)
     emit(result)
@@ -1070,6 +1091,9 @@ def bench_multicore(n_ops_per_node=200, keys=50, procs_list=(1, 2, 4),
 
     from accord_tpu.host.tcp import TcpClusterClient
 
+    # the per-verb CPU waterfall rides this lane's row too (see bench_tcp)
+    os.environ.setdefault("ACCORD_CPU_PROFILE", "2")
+
     try:
         cpus = sorted(os.sched_getaffinity(0))
     except AttributeError:  # non-linux
@@ -1150,7 +1174,10 @@ def bench_multicore(n_ops_per_node=200, keys=50, procs_list=(1, 2, 4),
         "client_inflight": depth,
     }
     if obs_summary is not None:
+        cpu_summary = obs_summary.pop("cpu", None)
         result["obs"] = obs_summary
+        if cpu_summary is not None and cpu_summary.get("sampled"):
+            result["cpu"] = cpu_summary
     emit(result)
 
 
@@ -1603,6 +1630,13 @@ GUARD_PCT = 15.0  # per-kernel (and headline) regression threshold, percent
 SLO_GUARD_MIN_COUNT = 20
 SLO_GUARD_FLOOR_US = 500
 
+# per-verb protocol-CPU gates (the "cpu" row key, obs/cpuprof.py): same
+# sample-count discipline; the floor is lower because per-dispatch applies
+# sit in the tens-to-hundreds of us (the env override lets the guard tests
+# exercise the gate on small runs whose baselines sit under the floor)
+CPU_GUARD_MIN_COUNT = 20
+CPU_GUARD_FLOOR_US = float(os.environ.get("ACCORD_CPU_GUARD_FLOOR_US", "20"))
+
 
 def _load_history() -> dict:
     try:
@@ -1637,7 +1671,56 @@ def _guard_problems(current: dict, baseline: dict) -> list:
                 f"kernel {kernel}: p50 {b['p50']}us -> {c['p50']}us "
                 f"(+{(c['p50'] / b['p50'] - 1) * 100:.0f}%)")
     problems.extend(_slo_problems(current, baseline))
+    problems.extend(_cpu_problems(current, baseline))
     return problems
+
+
+def _cpu_problems(current: dict, baseline: dict) -> list:
+    """Per-verb protocol-CPU regressions vs the baseline row's "cpu" key:
+    each verb's exact-sample per-dispatch p50 (obs/cpuprof.py) gates at
+    GUARD_PCT exactly like the per-kernel profile p50s — the yardstick the
+    coming `local/` optimizations are judged against must also be the
+    tripwire that catches their regressions."""
+    problems: list = []
+    cver = (current.get("cpu") or {}).get("verbs") or {}
+    bver = (baseline.get("cpu") or {}).get("verbs") or {}
+    for verb, c in sorted(cver.items()):
+        b = bver.get(verb)
+        if not b:
+            continue
+        if min(b.get("count", 0), c.get("count", 0)) < CPU_GUARD_MIN_COUNT:
+            continue
+        bv, cv = b.get("p50_us"), c.get("p50_us")
+        if not bv or not cv or bv < CPU_GUARD_FLOOR_US:
+            continue
+        if cv > bv * (1 + GUARD_PCT / 100.0):
+            problems.append(
+                f"cpu verb {verb}: p50 {bv}us -> {cv}us "
+                f"(+{(cv / bv - 1) * 100:.0f}%)")
+    return problems
+
+
+def _validate_cpu_schema(cpu: dict, where: str) -> None:
+    """The "cpu" row contract `--guard --dry-run` enforces on BENCH_HISTORY
+    (the same schema-rot discipline as the SLO rows): exact-sample
+    provenance, per-verb quantiles with stage splits, and the top-verbs
+    table the per-verb gate and the `local/` optimization work read."""
+    assert cpu.get("quantile_source") == "exact-sample", \
+        f"{where}: cpu rows must use exact-sample quantiles"
+    verbs = cpu.get("verbs")
+    assert isinstance(verbs, dict) and verbs, f"{where}: missing cpu verbs"
+    for verb, q in verbs.items():
+        for k in ("count", "p50_us", "p99_us", "dispatches",
+                  "est_total_ms", "stages"):
+            assert k in q, f"{where}: cpu verb {verb} missing {k}"
+        assert isinstance(q["stages"], dict), f"{where}: {verb} stages"
+        for st, sq in q["stages"].items():
+            assert "p50_us" in sq and "count" in sq, \
+                f"{where}: cpu verb {verb} stage {st}"
+    assert isinstance(cpu.get("top"), list) and cpu["top"], \
+        f"{where}: missing cpu top table"
+    assert cpu.get("sampled", 0) > 0 and cpu.get("dispatches", 0) > 0, \
+        f"{where}: cpu row with no samples"
 
 
 def _slo_tail_check(what: str, b: dict, c: dict, quantiles,
@@ -1776,6 +1859,11 @@ def run_guard_dry(config: str) -> int:
             _validate_slo_schema(entry["slo"], f"{config}/{pclass}")
             row["slo_open_p99_us"] = entry["slo"]["open_loop"].get("p99_us")
             row["slo_phases"] = sorted(entry["slo"]["phases"])
+        if "cpu" in entry:
+            # CPU-row schema validation: the per-verb gate reads these
+            _validate_cpu_schema(entry["cpu"], f"{config}/{pclass}")
+            row["cpu_verbs"] = sorted(entry["cpu"]["verbs"])
+            row["cpu_top"] = [v for v, _ms, _share in entry["cpu"]["top"]]
         checked.append(row)
     print(json.dumps({"metric": f"{config}_guard", "dry_run": True,
                       "history": HISTORY_PATH, "baselines": checked}))

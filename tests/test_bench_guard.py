@@ -180,6 +180,74 @@ def test_tcp_row_carries_coalescing_obs():
         assert transport[hkey]["p50"] is not None
 
 
+# ------------------------------- protocol-CPU waterfall rows (ISSUE 9) --
+
+def test_cpu_guard_dry_run_validates_cpu_row_schema():
+    """The tcp row must carry the per-verb protocol-CPU waterfall
+    ("cpu" key: exact-sample per-(verb, stage) quantiles + top-verbs
+    table) and stay guard-parseable — schema rot must fail CI, not
+    silently stop the per-verb gate."""
+    proc = _run(["--config", "tcp", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "tcp_guard" and row["dry_run"] is True
+    assert row["baselines"], "no tcp baseline in BENCH_HISTORY.json"
+    base = row["baselines"][0]
+    assert base["cpu_verbs"], "tcp row lost its cpu waterfall"
+    assert "PRE_ACCEPT_REQ" in base["cpu_verbs"]
+    assert base["cpu_top"], "tcp row lost its top-verbs table"
+    # the pipeline lane rides the same recording path
+    proc = _run(["--config", "pipeline", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["baselines"] and row["baselines"][0]["cpu_verbs"]
+
+
+def test_cpu_guard_dry_run_rejects_bucket_quantile_rows(tmp_path):
+    """A cpu row claiming anything but the exact-sample quantile path must
+    fail the dry run (same PR-3 precedent as the SLO rows: bucket
+    quantiles false-trip a 15%% gate)."""
+    hist = tmp_path / "hist.json"
+    good = json.load(open(os.path.join(REPO, "BENCH_HISTORY.json")))
+    lane = json.loads(json.dumps(good["tcp"]))  # deep copy
+    lane["host"]["cpu"]["quantile_source"] = "log2-bucket"
+    hist.write_text(json.dumps({"tcp": lane}))
+    proc = _run(["--config", "tcp", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "exact-sample" in (proc.stderr + proc.stdout)
+
+
+def test_cpu_guard_exits_nonzero_on_synthetic_per_verb_slowdown(tmp_path):
+    """ISSUE 9 acceptance: --guard must exit nonzero when a verb's
+    per-dispatch CPU p50 regresses vs the recorded baseline (synthesized
+    via the profiler's ACCORD_CPU_SCALE hook against a scratch history on
+    a shrunken tcp lane), retire the failed row, and restore the
+    baseline."""
+    hist = str(tmp_path / "hist.json")
+    env = {"ACCORD_BENCH_HISTORY": hist,
+           "ACCORD_BENCH_TCP_OPS": "60", "ACCORD_BENCH_TCP_KEYS": "20",
+           "ACCORD_CPU_PROFILE": "1",
+           # small runs' per-dispatch baselines can sit under the default
+           # 20us floor: gate every verb with enough samples
+           "ACCORD_CPU_GUARD_FLOOR_US": "0"}
+    first = _run(["--config", "tcp", "--guard"], env, timeout=300)
+    assert first.returncode == 0, first.stderr
+    assert "no clean baseline" in first.stderr
+    baseline_cpu = json.load(open(hist))["tcp"]["host"]["cpu"]
+    assert baseline_cpu["verbs"], "baseline run recorded no cpu waterfall"
+    slow = _run(["--config", "tcp", "--guard"],
+                dict(env, ACCORD_CPU_SCALE="4"), timeout=300)
+    assert slow.returncode != 0, (slow.stdout, slow.stderr)
+    assert "cpu verb" in slow.stderr
+    # failed row retired (stale + guard_failed), clean baseline restored
+    lane = json.load(open(hist))["tcp"]
+    assert "guard_failed" not in lane["host"]
+    assert lane["host"]["cpu"] == baseline_cpu
+    assert any(e.get("guard_failed") and e.get("stale")
+               for e in lane["superseded"])
+
+
 def test_slo_journal_lane_guard_dry_run_validates_schema():
     """The durable-WAL SLO lane (fsync-stall arm's home) must carry a
     schema-valid exact-sample SLO row like every other slo-* lane."""

@@ -1,0 +1,308 @@
+"""Protocol-CPU attribution profiler + event-loop health telemetry (ISSUE 9).
+
+Covers the obs/cpuprof.py tentpole end to end: the CpuProfiler unit
+contract (sampling, additive stage decomposition, export/merge, the
+ACCORD_CPU_SCALE guard hook), LoopHealth's gauges and alarms, the
+sampled-on burn (every dispatched verb appears in the merged "cpu"
+section with plausible stage splits), the live views (httpd `GET /top`,
+tcp "top" frame via TcpClusterClient.fetch_top), the Maelstrom host's
+loop-health parity, and the folded-in `ACCORD_TCP_PROFILE` cProfile
+deep-dive tier (per-node dumps written and pstats-loadable).
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from accord_tpu.obs.cpuprof import (CpuProfiler, LoopHealth,
+                                    cpu_profiler_from_env,
+                                    merge_cpu_exports)
+from accord_tpu.obs.registry import Registry
+
+
+# ------------------------------------------------------------ unit tests ----
+
+def _fake_clock(steps):
+    """Deterministic clock: yields successive values from `steps`."""
+    it = iter(steps)
+    return lambda: next(it)
+
+
+def test_profiler_off_by_default_and_disabled_hooks_are_inert():
+    prof = cpu_profiler_from_env(Registry())
+    assert not prof.enabled and not prof.active
+    # the node hook pattern with profiling off: nothing recorded
+    assert (prof.enabled and prof.dispatch_begin("X")) is False
+    assert prof.export() is None
+
+
+def test_sampling_one_in_n():
+    prof = CpuProfiler(Registry(), sample_n=3)
+    sampled = 0
+    for _ in range(12):
+        if prof.dispatch_begin("PRE_ACCEPT_REQ"):
+            sampled += 1
+            prof.dispatch_end()
+    assert sampled == 4  # 1-in-3
+    cpu = prof.export()
+    assert cpu["dispatches"]["PRE_ACCEPT_REQ"] == 12
+    assert cpu["sampled"] == 4
+
+
+def test_stage_decomposition_is_additive():
+    """decode + apply + cfk + reply_encode == total, with "apply" the
+    exclusive remainder after the nested fences."""
+    # clock sequence: dispatch t0=10; cfk fence 11->14 (3); reply fence
+    # 15->16 (1); dispatch end at 20 -> total wall 10, apply 10-3-1=6
+    clock = _fake_clock([10.0, 11.0, 14.0, 15.0, 16.0, 20.0])
+    prof = CpuProfiler(Registry(), sample_n=1, clock=clock)
+    prof.note_decode(2.0)
+    assert prof.dispatch_begin("ACCEPT_REQ")
+    t = prof.stage_begin()
+    prof.stage_end(t, "cfk")
+    t = prof.stage_begin()
+    prof.stage_end(t, "reply_encode")
+    prof.dispatch_end()
+    cpu = prof.export()
+    stages = cpu["stages"]["ACCEPT_REQ"]
+    assert stages["decode"] == [2e6]
+    assert stages["cfk"] == [3e6]
+    assert stages["reply_encode"] == [1e6]
+    assert stages["apply"] == [6e6]
+    # total includes the decode lap parked before the bracket opened
+    assert cpu["totals"]["ACCEPT_REQ"] == [12e6]
+
+
+def test_nested_dispatch_is_absorbed_not_double_counted():
+    clock = _fake_clock([10.0, 20.0])
+    prof = CpuProfiler(Registry(), sample_n=1, clock=clock)
+    assert prof.dispatch_begin("OUTER_REQ")
+    # a nested local apply inside the open sample must not start a second
+    # sample (its verb is still censused)
+    assert not prof.dispatch_begin("INNER_MSG")
+    prof.dispatch_end()
+    cpu = prof.export()
+    assert cpu["dispatches"] == {"OUTER_REQ": 1, "INNER_MSG": 1}
+    assert list(cpu["totals"]) == ["OUTER_REQ"]
+
+
+def test_cpu_scale_hook_scales_recorded_durations(monkeypatch):
+    """ACCORD_CPU_SCALE is the synthetic-slowdown lever the bench guard
+    tests pull (tests/test_bench_guard.py)."""
+    monkeypatch.setenv("ACCORD_CPU_SCALE", "4")
+    clock = _fake_clock([0.0, 1.0])
+    prof = CpuProfiler(Registry(), sample_n=1, clock=clock)
+    assert prof.dispatch_begin("X_REQ")
+    prof.dispatch_end()
+    assert prof.export()["totals"]["X_REQ"] == [4e6]
+
+
+def test_merge_cpu_exports_pools_samples_and_sums_census():
+    a = {"sampled": 2, "dispatches": {"A": 4}, "totals": {"A": [1.0, 2.0]},
+         "stages": {"A": {"apply": [1.0, 2.0]}}}
+    b = {"sampled": 1, "dispatches": {"A": 2, "B": 1},
+         "totals": {"A": [3.0], "B": [5.0]},
+         "stages": {"A": {"apply": [3.0]}, "B": {"apply": [5.0]}}}
+    merged = merge_cpu_exports([a, None, b])
+    assert merged["sampled"] == 3
+    assert merged["dispatches"] == {"A": 6, "B": 1}
+    assert merged["totals"]["A"] == [1.0, 2.0, 3.0]
+    assert merged["stages"]["A"]["apply"] == [1.0, 2.0, 3.0]
+    assert merge_cpu_exports([None, None]) is None
+
+
+def test_cpu_section_top_table_scales_by_dispatch_census():
+    """1-in-N sampling must not skew the top-verbs ranking: estimated
+    totals scale each verb's sampled mean by its FULL dispatch count."""
+    from accord_tpu.obs.report import cpu_section
+    cpu = {"sampled": 3,
+           # B is individually cheaper but dispatched 100x more often
+           "dispatches": {"A": 2, "B": 200},
+           "totals": {"A": [100.0, 100.0], "B": [10.0]},
+           "stages": {"A": {"apply": [100.0, 100.0]},
+                      "B": {"apply": [10.0]}}}
+    section = cpu_section(cpu)
+    assert section["quantile_source"] == "exact-sample"
+    assert section["top"][0][0] == "B"  # 200 * 10us > 2 * 100us
+    shares = [row[2] for row in section["top"]]
+    assert abs(sum(shares) - 1.0) < 1e-6
+    assert section["verbs"]["A"]["p50_us"] == 100
+    assert section["verbs"]["A"]["stages"]["apply"]["count"] == 2
+
+
+# ------------------------------------------------------------ loop health ----
+
+def test_loop_health_lag_histogram_and_rate_limited_alarm():
+    from accord_tpu.obs.flight import FlightRecorder
+    reg = Registry()
+    flight = FlightRecorder(1, clock_us=lambda: 0)
+    wall = [0.0]
+    lh = LoopHealth(reg, flight, clock=lambda: wall[0])
+    lh.lag_alarm_us = 1000
+    lh.timer_lag(0.0001)            # 100us: under the alarm
+    assert reg.value("accord_loop_lag_alarms_total") == 0
+    lh.timer_lag(0.5)               # 500ms: alarms + flight record
+    lh.timer_lag(0.5)               # same instant: rate-limited off the ring
+    assert reg.value("accord_loop_lag_alarms_total") == 2
+    lags = [e for e in flight.events if e[2] == "loop_lag"]
+    assert len(lags) == 1 and lags[0][4] == (500000,)
+    wall[0] = 1.0                   # past the rate-limit window
+    lh.timer_lag(0.5)
+    assert len([e for e in flight.events if e[2] == "loop_lag"]) == 2
+    hist = reg.histogram("accord_loop_lag_us")
+    assert hist.count == 4
+
+
+def test_loop_health_tick_gauges_and_saturation_edge_trigger():
+    from accord_tpu.obs.flight import FlightRecorder
+    reg = Registry()
+    flight = FlightRecorder(1, clock_us=lambda: 0)
+    lh = LoopHealth(reg, flight, clock=lambda: 0.0)
+    lh.saturation_depth = 10
+    lh.tick(0.002, 5, 3)
+    lh.tick(0.001, 0, 12)           # saturated: alarm fires once
+    lh.tick(0.001, 1, 15)           # still saturated: edge-triggered, quiet
+    lh.tick(0.001, 1, 2)            # drained below half: re-arms
+    lh.tick(0.001, 1, 11)           # second crossing alarms again
+    assert reg.value("accord_loop_queue_saturation_total") == 2
+    sats = [e for e in flight.events if e[2] == "queue_saturation"]
+    assert [e[4] for e in sats] == [(12,), (11,)]
+    assert reg.gauge("accord_loop_depth_max").value == 15
+    assert reg.histogram("accord_loop_tick_us").count == 5
+    assert reg.histogram("accord_loop_burst_msgs").count == 4  # burst=0 skipped
+
+
+# ------------------------------------------------------ burn integration ----
+
+def test_sampled_burn_covers_every_dispatched_verb(monkeypatch):
+    """ISSUE 9 satellite: with ACCORD_CPU_PROFILE=1 every dispatch is
+    sampled, so every verb any replica processed must appear in the merged
+    "cpu" section with plausible stage splits (additive waterfall: a
+    stage's p50 can never exceed the per-dispatch total's)."""
+    monkeypatch.setenv("ACCORD_CPU_PROFILE", "1")
+    from accord_tpu.sim.burn import BurnRun
+    run = BurnRun(7, 40, durability_cycle_s=2.0, topology_changes=False)
+    stats = run.run()
+    assert stats.acks > 0
+    cpu = run.metrics_snapshot()["summary"]["cpu"]
+    assert cpu["quantile_source"] == "exact-sample"
+    assert cpu["sampled"] == cpu["dispatches"] > 0
+    # independent verb census: the flight rings' rx events record every
+    # inbound dispatch right where the profiler brackets it
+    rx_verbs = {e[4][1] for rec in run.flight_recorders()
+                for e in rec.events if e[2] == "rx"}
+    assert rx_verbs, "burn produced no rx flight events?"
+    missing = rx_verbs - set(cpu["verbs"])
+    assert not missing, f"dispatched verbs missing from cpu section: {missing}"
+    # plausible stage splits: every verb decomposes additively, and the
+    # protocol's deps work shows up as the cfk stage where it must
+    for verb, q in cpu["verbs"].items():
+        assert q["count"] > 0 and q["p50_us"] >= 0
+        assert "apply" in q["stages"], (verb, sorted(q["stages"]))
+        for st, sq in q["stages"].items():
+            assert sq["p50_us"] <= q["p50_us"] + 1, (verb, st)
+    assert "PRE_ACCEPT_REQ" in cpu["verbs"]
+    pre = cpu["verbs"]["PRE_ACCEPT_REQ"]["stages"]
+    assert "cfk" in pre and pre["cfk"]["count"] > 0
+    assert pre["cfk"]["mean_us"] > 0
+    # the top table ranks by estimated total CPU and its shares sum to 1
+    assert cpu["top"] and cpu["top"][0][1] >= cpu["top"][-1][1]
+    assert abs(sum(r[2] for r in cpu["top"]) - 1.0) < 0.51  # top-10 cut
+
+
+def test_burn_cpu_top_cli_prints_section(capsys, monkeypatch):
+    monkeypatch.setenv("ACCORD_CPU_PROFILE", "1")
+    from accord_tpu.sim.burn import main as burn_main
+    rc = burn_main(["-s", "3", "-o", "15", "--cpu-top", "--no-audit"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    line = next(ln for ln in out.splitlines() if ln.startswith("cpu "))
+    section = json.loads(line[4:])
+    assert section["sampled"] > 0 and section["top"]
+
+
+# ------------------------------------------------------------- live views ----
+
+def test_httpd_top_route_serves_cpu_view(monkeypatch):
+    monkeypatch.setenv("ACCORD_CPU_PROFILE", "1")
+    from accord_tpu.obs import NodeObs
+    from accord_tpu.obs.httpd import start_metrics_server
+    obs = NodeObs(3, clock_us=lambda: 0)
+    assert obs.cpuprof.dispatch_begin("PRE_ACCEPT_REQ")
+    obs.cpuprof.dispatch_end()
+    server = start_metrics_server(lambda: obs, 0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/top", timeout=10).read()
+        view = json.loads(body)
+        assert view["node"] == 3
+        assert "PRE_ACCEPT_REQ" in view["cpu"]["verbs"]
+        assert "lag_us" in view["loop"]
+    finally:
+        server.shutdown()
+
+
+def test_tcp_cluster_fetch_top_and_cprofile_deep_dive(tmp_path, monkeypatch):
+    """One real node process, both profiling tiers on: the "top" frame
+    returns the live per-verb waterfall + loop health, and the orphaned
+    ACCORD_TCP_PROFILE cProfile path (the deep-dive tier) writes a
+    per-node dump that pstats can load (ISSUE 9 satellite — it previously
+    had no test at all)."""
+    import pstats
+
+    from accord_tpu.host.tcp import TcpClusterClient
+    prof_path = str(tmp_path / "prof")
+    monkeypatch.setenv("ACCORD_TCP_PROFILE", prof_path)
+    monkeypatch.setenv("ACCORD_CPU_PROFILE", "1")
+    c = TcpClusterClient(n_nodes=1)
+    try:
+        for i in range(4):
+            c.submit(1, [i], {i: i + 1}, req=i)
+        done = 0
+        deadline = time.monotonic() + 30
+        while done < 4 and time.monotonic() < deadline:
+            frame = c.recv(5.0)
+            if frame and frame.get("body", {}).get("type") == "submit_reply":
+                assert frame["body"]["ok"], frame
+                done += 1
+        assert done == 4
+        top = c.fetch_top(1)
+        assert top is not None
+        assert top["cpu"]["sampled"] > 0
+        assert "PRE_ACCEPT_REQ" in top["cpu"]["verbs"]
+        assert top["loop"]["tick_us"]["count"] > 0
+        assert top["loop"]["burst_msgs"]["count"] > 0
+    finally:
+        c.close()
+    dump = f"{prof_path}.1"
+    assert os.path.exists(dump), "ACCORD_TCP_PROFILE wrote no dump"
+    stats = pstats.Stats(dump)
+    assert stats.total_calls > 0
+
+
+# ---------------------------------------------------- maelstrom parity ----
+
+def test_maelstrom_host_wires_loop_health(monkeypatch):
+    """ISSUE 9 satellite: the Maelstrom loop got the PR-8 due-timer fix
+    but no way to observe timer lateness — it must now wire the same
+    LoopHealth layer as the TCP loop (lag observer on the scheduler, tick
+    gauges from the stdin loop)."""
+    import io
+
+    from accord_tpu.host.maelstrom import MaelstromHost
+    init = json.dumps({"src": "c0", "dest": "n1",
+                       "body": {"type": "init", "msg_id": 1,
+                                "node_id": "n1", "node_ids": ["n1"]}})
+    out = io.StringIO()
+    host = MaelstromHost(stdin=io.StringIO(init + "\n"), stdout=out)
+    host.run()
+    assert host.loop_health is not None
+    assert host.scheduler.lag_observer == host.loop_health.timer_lag
+    reg = host.node.obs.registry
+    # the init batch itself ticked the loop gauges
+    assert reg.histogram("accord_loop_tick_us").count >= 1
+    assert reg.histogram("accord_loop_burst_msgs").count >= 1
+    assert '"init_ok"' in out.getvalue()

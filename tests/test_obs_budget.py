@@ -383,3 +383,52 @@ def test_profiler_off_overhead_under_2pct_of_scalar_hot_loop():
     assert ratio < 0.02, (
         f"profiler-off bundle {prof_us:.2f}us vs scalar hot loop "
         f"{loop_us:.1f}us: {ratio:.1%} >= 2% budget")
+
+
+# --------------------------------------------- protocol-CPU-profiler budget --
+
+def _cpuprof_off_bundle_cost_us(reps=2000):
+    """min-of-3 per-TXN cost of the protocol-CPU profiler hooks with
+    ACCORD_CPU_PROFILE unset: the exact call pattern the dispatch path
+    executes per transaction on one node — 5 dispatch brackets
+    (Node._process: one `enabled` check each), 5 reply fences (Node.reply:
+    one `active` check), and 6 cfk fence checks (SafeCommandStore.register
+    per key + calculate_deps) — all early-outs."""
+    from accord_tpu.obs.cpuprof import cpu_profiler_from_env
+    from accord_tpu.obs.registry import Registry
+    assert not os.environ.get("ACCORD_CPU_PROFILE"), \
+        "budget test needs the profiler-off default"
+    prof = cpu_profiler_from_env(Registry())
+    assert not prof.enabled
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for _dispatch in range(5):
+                sampled = prof.enabled and prof.dispatch_begin("X_REQ")
+                if prof.active:
+                    t = prof.stage_begin()
+                    prof.stage_end(t, "reply_encode")
+                for _fence in range(6):
+                    t = prof.stage_begin() if prof is not None \
+                        and prof.active else None
+                    if t is not None:
+                        prof.stage_end(t, "cfk")
+                if sampled:
+                    prof.dispatch_end()
+        dt = (time.perf_counter() - t0) / reps * 1e6
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def test_cpuprof_off_overhead_under_2pct_of_scalar_hot_loop():
+    """ISSUE 9 acceptance: with ACCORD_CPU_PROFILE unset (the default),
+    the per-dispatch attribution hooks across the whole dispatch path
+    must cost <2% of the rf=3 x 1024-entry scalar active-scan hot loop
+    per transaction."""
+    prof_us = _cpuprof_off_bundle_cost_us()
+    loop_us = _scalar_hot_loop_cost_us()
+    ratio = prof_us / loop_us
+    assert ratio < 0.02, (
+        f"cpuprof-off bundle {prof_us:.2f}us vs scalar hot loop "
+        f"{loop_us:.1f}us per txn: {ratio:.1%} >= 2% budget")

@@ -201,6 +201,28 @@ def test_frame_coalescing_kinds_are_covered():
             (kind, recorded[kind])
 
 
+def test_loop_health_kinds_are_covered():
+    """The event-loop health alarms must stay on the forensics ring:
+    timer lateness past the alarm threshold (`loop_lag`) and backlog
+    crossing the saturation threshold (`queue_saturation`), both recorded
+    by obs/cpuprof.LoopHealth (wired into host/tcp.py and
+    host/maelstrom.py).  Pinned as a SET like the journal lifecycle
+    below, so a hook cannot vanish together with its EVENT_KINDS row."""
+    recorded = _recorded_flight_kinds()
+    for kind in ("loop_lag", "queue_saturation"):
+        assert kind in EVENT_KINDS, f"{kind} missing from EVENT_KINDS"
+        assert kind in recorded, f"nothing records {kind}"
+        assert any(p.startswith("obs") for p in recorded[kind]), \
+            (kind, recorded[kind])
+    # and both hosts actually wire the LoopHealth layer (the recorder
+    # lives in obs/ — a host dropping the wiring would silently lose the
+    # telemetry while this lint stayed green on the obs-side literal)
+    for host_file in ("tcp.py", "maelstrom.py"):
+        src = open(os.path.join(ROOT, "host", host_file)).read()
+        assert "LoopHealth(" in src and "lag_observer" in src, \
+            f"host/{host_file} lost its LoopHealth wiring"
+
+
 def test_journal_lifecycle_kinds_are_covered():
     """The durable WAL's full lifecycle must stay on the forensics ring:
     append, segment rotation, snapshot compaction, and both replay edges.
