@@ -518,7 +518,13 @@ class TcpHost:
         # WHILE A BURST IS IN PROGRESS (the loop flushes everything the
         # moment it would otherwise go idle, so an unloaded request never
         # pays the tick); 0 flushes after every dispatched item
-        self.flush_tick_us = _env_int("ACCORD_TCP_FLUSH_TICK_US", 1000)
+        # coalescing window default raised 1000 -> 2500us (ISSUE 10): on a
+        # core-starved box every frame syscall is also a likely preemption
+        # point for the peer processes, so deeper coalescing cuts protocol
+        # CPU twice over (measured: ~1/3 fewer frames, +6% tcp lane, lower
+        # per-verb dispatch p50s).  Unloaded latency is unaffected — the
+        # loop still flushes immediately on idle.
+        self.flush_tick_us = _env_int("ACCORD_TCP_FLUSH_TICK_US", 2500)
         self._out: Dict[int, _PeerLane] = {}
         self.running = True
 
@@ -553,7 +559,10 @@ class TcpHost:
         self.node = Node(my_id, self.sink, agent, self.scheduler,
                          ListStore(my_id), RandomSource(my_id), num_shards=1,
                          store_factory=_env_store_factory(),
-                         now_us=lambda: int(time.time() * 1e6))
+                         # time_ns // 1000: no float round-trip — this
+                         # clock runs per flight/span event, not just per
+                         # HLC mint
+                         now_us=lambda: time.time_ns() // 1000)
         self.flight = self.node.obs.flight
         # always-on event-loop health telemetry (obs/cpuprof.LoopHealth):
         # timer-lag histogram via the scheduler hook, tick/burst/backlog

@@ -652,7 +652,7 @@ class DeviceCommandStore(CommandStore):
         scalar."""
         owned = keys.slice(self.ranges) if not self.ranges.is_empty else keys
         if isinstance(owned, Ranges):
-            return sorted(k for k in self.cfks if owned.contains(k))
+            return self.cfk_keys_in(owned)
         return list(owned)
 
     def _probe_snapshots(self, probes):

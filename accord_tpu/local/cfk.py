@@ -36,9 +36,23 @@ import heapq
 from bisect import bisect_left, bisect_right, insort
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from accord_tpu import native
 from accord_tpu.primitives.keys import Key
-from accord_tpu.primitives.timestamp import Timestamp, TxnId, TxnKind, KindSet
+from accord_tpu.primitives.timestamp import (_KIND_MASK, _KIND_SHIFT,
+                                             _WITNESS_BITS, Timestamp, TxnId,
+                                             TxnKind, KindSet)
 from accord_tpu.utils import invariants
+
+# the native CommandsForKey core (native/_cfk_core.cpp): one C pass for each
+# of the three hot loops over the SAME parallel arrays this class owns; None
+# means the bit-identical Python tier (no toolchain, ACCORD_NATIVE=0, or
+# ACCORD_NO_NATIVE=1).  tests/test_cfk_native.py cross-checks the tiers on
+# randomized op sequences; monkeypatching this global selects a tier.
+_NATIVE = native.get_cfk()
+
+# kinds visible in other txns' deps (TxnKind.is_globally_visible), as a bit
+# mask over kind ints — the per-entry visibility test in _block_point
+_VISIBLE_MASK = sum(1 << int(k) for k in TxnKind if k.is_globally_visible)
 
 
 class InternalStatus(enum.IntEnum):
@@ -193,6 +207,8 @@ class CommandsForKey:
     # ------------------------------------------------------------ plumbing --
     def _pos(self, txn_id: TxnId) -> int:
         """Index of txn_id, or -(insert_pos)-1 if absent."""
+        if _NATIVE is not None:
+            return _NATIVE.pos(self._ids, txn_id)
         i = bisect_left(self._ids, txn_id)
         if i < len(self._ids) and self._ids[i] == txn_id:
             return i
@@ -232,13 +248,20 @@ class CommandsForKey:
             cur = self._status[pos]
             if status < cur:
                 return []  # per-key view is monotone
-            if status == cur and not status.has_info:
+            if status == cur and not InternalStatus.ACCEPTED <= status \
+                    <= InternalStatus.APPLIED:  # not has_info
                 return []
             self.version += 1
             self.last_mutator = txn_id
-            was_committed = cur.is_committed
+            # status-band tests inlined (enum <=> enum is a C int compare;
+            # the properties cost a descriptor dispatch per call and update
+            # consulted them three times per transition)
+            was_committed = InternalStatus.COMMITTED <= cur \
+                <= InternalStatus.APPLIED
+            now_committed = InternalStatus.COMMITTED <= status \
+                <= InternalStatus.APPLIED
             old_eat = self._eat_of(pos)
-            if was_committed and status.is_committed \
+            if was_committed and now_committed \
                     and execute_at is not None \
                     and old_eat != execute_at:
                 # executeAt is fixed at commit; keep the committed view exact
@@ -247,27 +270,30 @@ class CommandsForKey:
             self._status[pos] = status
             if execute_at is not None:
                 self._eat[pos] = None if execute_at == txn_id else execute_at
-            if status.is_committed and not was_committed:
-                self._committed_add(txn_id, self._eat_of(self._pos(txn_id)))
+            # pos is stable through this branch (no inserts): reuse it
+            # instead of re-bisecting per step
+            if now_committed and not was_committed:
+                self._committed_add(txn_id, self._eat_of(pos))
             if status == InternalStatus.INVALID_OR_TRUNCATED and was_committed:
                 # use the eat recorded before the mutation above, so the exact
                 # (eat, txn_id) pair leaves _committed even if the caller
                 # passed a differing execute_at
                 self._committed_remove(txn_id, old_eat)
-            if status.is_decided and not (cur.is_decided):
+            if status >= InternalStatus.COMMITTED \
+                    and cur < InternalStatus.COMMITTED:
                 # newly Committed-or-higher: elide from all missing[]
                 self._remove_missing(txn_id)
-            self._push_block_point(self._pos(txn_id))
+            self._push_block_point(pos)
         else:
             self.version += 1
             self.last_mutator = txn_id
-            insert_at = -pos - 1
-            self._insert(insert_at, txn_id, status, execute_at)
+            pos = -pos - 1
+            self._insert(pos, txn_id, status, execute_at)
             if status.is_committed:
-                self._committed_add(txn_id, self._eat_of(self._pos(txn_id)))
+                self._committed_add(txn_id, self._eat_of(pos))
 
         if status.has_info and dep_ids is not None:
-            self._apply_deps(txn_id, status, dep_ids)
+            self._apply_deps(txn_id, status, dep_ids, pos=pos)
 
         if status.is_committed or status == InternalStatus.INVALID_OR_TRUNCATED:
             return self._notify_unmanaged()
@@ -289,6 +315,11 @@ class CommandsForKey:
             self._add_missing_everywhere(txn_id)
 
     def _add_missing_everywhere(self, new_id: TxnId) -> None:
+        if _NATIVE is not None:
+            _NATIVE.add_missing_everywhere(self._ids, self._status, self._eat,
+                                           self._missing, new_id,
+                                           _WITNESS_BITS)
+            return
         for j in range(len(self._ids)):
             if self._ids[j] == new_id or not self._status[j].has_info:
                 continue
@@ -303,6 +334,9 @@ class CommandsForKey:
     def _remove_missing(self, txn_id: TxnId) -> None:
         """Elide a newly-committed id from every missing collection
         (removeMissing, :962-987)."""
+        if _NATIVE is not None:
+            _NATIVE.remove_missing(self._missing, txn_id)
+            return
         for j in range(len(self._missing)):
             m = self._missing[j]
             if not m:
@@ -312,17 +346,35 @@ class CommandsForKey:
                 self._missing[j] = m[:k] + m[k + 1:]
 
     def _apply_deps(self, txn_id: TxnId, status: InternalStatus,
-                    dep_ids: Sequence[TxnId]) -> None:
+                    dep_ids: Sequence[TxnId],
+                    pos: Optional[int] = None) -> None:
         """Install the entry's own missing[] divergence and insert any dep
-        ids not yet witnessed here (the additions path, :738-860)."""
+        ids not yet witnessed here (the additions path, :738-860).  `pos` —
+        txn_id's known index in the arrays (update just positioned it),
+        adjusted here as additions land below it."""
+        if _NATIVE is not None:
+            _NATIVE.apply_deps(self._ids, self._status, self._eat,
+                               self._missing, self._wdeps, txn_id,
+                               int(status), dep_ids,
+                               InternalStatus.TRANSITIVELY_KNOWN,
+                               _WITNESS_BITS)
+            return
         dep_set = set(dep_ids)
-        # additions: deps referencing ids this key has never witnessed
-        additions = sorted(t for t in dep_set
-                           if t.is_key_domain and self._pos(t) < 0)
-        for t in additions:
-            i = -self._pos(t) - 1
+        if pos is None:
+            pos = self._pos(txn_id)
+        # additions: deps referencing ids this key has never witnessed —
+        # one bisect each (walking sorted keeps later probes exact), with
+        # txn_id's index shifted as inserts land below it
+        for t in sorted(dep_set):
+            if not t.is_key_domain:
+                continue
+            p = self._pos(t)
+            if p >= 0:
+                continue
+            i = -p - 1
             self._insert(i, t, InternalStatus.TRANSITIVELY_KNOWN, None)
-        pos = self._pos(txn_id)
+            if i <= pos:
+                pos += 1
         bound = _deps_known_before(txn_id, status, self._eat[pos])
         missing: List[TxnId] = []
         hi = bisect_left(self._ids, bound)
@@ -415,6 +467,8 @@ class CommandsForKey:
                                    ) -> Optional[Timestamp]:
         """Max executeAt among committed WRITES executing strictly before
         `before` — the transitive-elision bound."""
+        if not self._committed:
+            return None
         i = bisect_left(self._committed, (before,))
         i -= 1
         while i >= 0 and not self._committed[i][1].kind.is_write:
@@ -437,6 +491,12 @@ class CommandsForKey:
         missing[] divergence) and never become deps themselves.
         """
         bound = self.max_committed_write_before(before) if prune else None
+        if _NATIVE is not None:
+            for t in _NATIVE.map_reduce_active(self._ids, self._status,
+                                               self._eat, before,
+                                               kinds.mask(), bound):
+                fn(t)
+            return
         hi = bisect_left(self._ids, before)
         for i in range(hi):
             t = self._ids[i]
@@ -472,9 +532,12 @@ class CommandsForKey:
         else:
             start, end = 0, len(self._ids)
 
+        kmask = kinds.mask()
         for i in range(start, end):
             t = self._ids[i]
-            if t == test_txn_id or t.kind not in kinds:
+            if t == test_txn_id \
+                    or not (kmask >> ((t.flags & _KIND_MASK)
+                                      >> _KIND_SHIFT)) & 1:
                 continue
             st = self._status[i]
             if test_status == TestStatus.IS_PROPOSED:
@@ -741,12 +804,18 @@ class CommandsForKey:
     def _block_point(self, i: int) -> Optional[Timestamp]:
         st = self._status[i]
         t = self._ids[i]
-        if st.is_terminal or st == InternalStatus.TRANSITIVELY_KNOWN \
-                or not t.is_visible:
+        # int-band tests instead of enum property dispatch: this runs per
+        # lazy-heap pop and per update (terminal = APPLIED|INVALID = >= 6;
+        # visibility via the precomputed kind mask)
+        if st >= InternalStatus.APPLIED \
+                or st == InternalStatus.TRANSITIVELY_KNOWN \
+                or not (_VISIBLE_MASK
+                        >> ((t.flags & _KIND_MASK) >> _KIND_SHIFT)) & 1:
             return None
         if self.redundant_before is not None and t < self.redundant_before:
             return None
-        return self._eat_of(i) if st.is_committed else t
+        return self._eat_of(i) \
+            if InternalStatus.COMMITTED <= st else t
 
     def _push_block_point(self, i: int) -> None:
         bp = self._block_point(i)

@@ -30,6 +30,11 @@ from accord_tpu.utils.sorted_arrays import find_ceil
 _current_store: Callable[[], Optional[object]] = lambda: None
 
 
+# enum .name goes through DynamicClassAttribute per access; transitions
+# record two names each, so resolve them once
+_STATUS_NAME = {s: s.name for s in SaveStatus}
+
+
 def note_status_transition(txn_id: TxnId, prev: SaveStatus,
                            new: SaveStatus) -> None:
     """Record a command status transition on the owning node's flight ring
@@ -42,7 +47,7 @@ def note_status_transition(txn_id: TxnId, prev: SaveStatus,
     flight = getattr(store, "flight", None)
     if flight is not None:
         flight.record("status", repr(txn_id),
-                      (store.id, prev.name, new.name))
+                      (store.id, _STATUS_NAME[prev], _STATUS_NAME[new]))
 
 
 class WaitingOn:
@@ -179,6 +184,7 @@ class Command:
         "partial_deps", "stable_deps", "waiting_on",
         "writes", "result",
         "listeners", "transient_listeners",
+        "owned_keys_memo",
     )
 
     def __init__(self, txn_id: TxnId):
@@ -198,6 +204,11 @@ class Command:
         self.result = None
         self.listeners: Set[TxnId] = set()         # durable: commands waiting on us
         self.transient_listeners: List[TransientListener] = []
+        # (keys, ranges, owned-slice) identity memo for owned_keys_of: the
+        # slice is recomputed per CFK registration (every transition), but
+        # partial_txn.keys and the store's Ranges are both immutable objects
+        # replaced wholesale on change — identity captures staleness exactly
+        self.owned_keys_memo: Optional[Tuple] = None
 
     # -- status predicates --
     @property

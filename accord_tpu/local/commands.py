@@ -750,7 +750,12 @@ def _safe_to_apply(safe_store: SafeCommandStore, cmd: Command) -> bool:
         return True
     sel = None
     if cmd.partial_txn is not None:
-        sel = cmd.partial_txn.keys.slice(safe_store.ranges)
+        if isinstance(cmd.partial_txn.keys, Keys):
+            # key-domain: share the identity-memoized owned slice that
+            # register computes per transition
+            sel = safe_store.owned_keys_of(cmd)
+        else:
+            sel = cmd.partial_txn.keys.slice(safe_store.ranges)
     elif cmd.route is not None:
         sel = cmd.route.slice(safe_store.ranges).participants()
     if sel is None:

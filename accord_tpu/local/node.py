@@ -107,6 +107,13 @@ class Node:
         self._progress_log_factory = progress_log_factory
         self._progress_logs: Dict[int, ProgressLog] = {}
         self._now_us = now_us or (lambda: 0)
+        if obs is None:
+            # the indirection above only existed because _now_us was not
+            # yet assigned; rebind the obs/flight clocks directly so every
+            # span/flight event saves a lambda hop (~37k clock reads per
+            # 400-txn TCP run)
+            self.obs._clock_us = self._now_us
+            self.obs.flight._clock_us = self._now_us
         self._hlc = 0
         # optional side-effecting-message journal (sim/journal.Journal);
         # when set, every has_side_effects request is recorded at processing
@@ -587,10 +594,20 @@ class Node:
                 self.reply(from_id, reply_context,
                            FailureReply(RuntimeError("no intersecting store")))
             return
-        pending: List[AsyncResult] = []
-        for s in stores:
-            raw = s.submit(context, request.apply)
-            pending.append(_flatten(raw))
+        if len(stores) == 1:
+            raw = stores[0].submit(context, request.apply)
+            if raw._done and raw._failure is None \
+                    and not isinstance(raw._value, AsyncResult):
+                # synchronous single-shard dispatch (the host-tier common
+                # case): the reply is already in hand — skip the
+                # flatten/all_of chain machinery entirely
+                if reply_context is not None:
+                    self.reply(from_id, reply_context, raw._value)
+                return
+            pending: List[AsyncResult] = [_flatten(raw)]
+        else:
+            pending = [_flatten(s.submit(context, request.apply))
+                       for s in stores]
         from accord_tpu.utils import async_chains
 
         def finish(values, failure):

@@ -14,6 +14,7 @@ via `execute`, with replies reduced across shards.
 
 from __future__ import annotations
 
+from bisect import bisect_left
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple, TYPE_CHECKING
 
 from accord_tpu.local.cfk import CommandsForKey, InternalStatus, TimestampsForKey, Unmanaged
@@ -21,7 +22,8 @@ from accord_tpu.local.command import Command
 from accord_tpu.local.status import SaveStatus
 from accord_tpu.local.watermarks import DurableBefore, MaxConflicts, RedundantBefore
 from accord_tpu.primitives.deps import Deps
-from accord_tpu.primitives.keys import Key, Keys, Range, Ranges, RoutingKey, _SortedKeyList
+from accord_tpu.primitives.keys import (EMPTY_KEYS, Key, Keys, Range, Ranges,
+                                        RoutingKey, _SortedKeyList)
 from accord_tpu.primitives.timestamp import KindSet, Timestamp, TxnId
 from accord_tpu.utils import invariants
 from accord_tpu.utils.async_chains import AsyncResult
@@ -46,7 +48,7 @@ class PreLoadContext:
                  deps_probes: Sequence = (), recovery_probes: Sequence = (),
                  execute_probes: Sequence = ()):
         self.txn_ids = tuple(txn_ids)
-        self.keys = keys if keys is not None else Keys(())
+        self.keys = keys if keys is not None else EMPTY_KEYS
         self.deps_probes = tuple(deps_probes)
         # (txn_id, Keys) of BeginRecovery's mapReduceFull predicate scans —
         # the batched device store precomputes them per flush window
@@ -134,7 +136,16 @@ class SafeCommandStore:
         range-domain commands, the keys with local conflict state inside the
         owned ranges (range txns have no enumerable key set of their own)."""
         if command.partial_txn is not None and isinstance(command.partial_txn.keys, Keys):
-            return command.partial_txn.keys.slice(self.ranges)
+            # identity-memoized: register() recomputes this slice on every
+            # transition of the same command over the same immutable
+            # (keys, ranges) pair — both are replaced wholesale on change
+            keys, ranges = command.partial_txn.keys, self.ranges
+            memo = command.owned_keys_memo
+            if memo is not None and memo[0] is keys and memo[1] is ranges:
+                return memo[2]
+            owned = keys.slice(ranges)
+            command.owned_keys_memo = (keys, ranges, owned)
+            return owned
         if command.txn_id.is_range_domain:
             ranges = None
             if command.partial_txn is not None:
@@ -157,26 +168,40 @@ class SafeCommandStore:
         missing[] divergence encoding."""
         if command.txn_id.is_range_domain:
             return  # range txns are tracked via rangeCommands, not per-key CFK
-        deps = None
+        key_deps = None
         if status.has_info:
             deps = command.stable_deps if command.stable_deps is not None \
                 else command.partial_deps
+            key_deps = deps.key_deps if deps is not None else None
         prof = self.store.cpuprof
-        for key in self.owned_keys_of(command):
-            dep_ids = deps.key_deps.txn_ids_for_key(key) \
-                if deps is not None else None
-            # cfk stage fence (obs/cpuprof.py): the conflict-index update
-            # is timed per key; fired Unmanaged callbacks run OUTSIDE the
-            # fence (they are execution work, not index maintenance) and
-            # keep their per-key interleaving
-            t = prof.stage_begin() if prof is not None and prof.active \
-                else None
-            fired = self.cfk(key).update(command.txn_id, status,
-                                         command.execute_at, dep_ids=dep_ids)
-            if t is not None:
-                prof.stage_end(t, "cfk")
-            for u in fired:
-                u.callback(self)
+        txn_id, execute_at = command.txn_id, command.execute_at
+        # cfk stage fence (obs/cpuprof.py): ONE batched fence brackets the
+        # whole per-key registration walk (not a fence re-entry per key);
+        # fired Unmanaged callbacks still run OUTSIDE the fence (they are
+        # execution work, not index maintenance) and keep their per-key
+        # interleaving — the fence is suspended around them and resumed
+        cfks = self.store.cfks
+        # owned-key routing resolves OUTSIDE the fence: the cfk stage
+        # measures conflict-index maintenance, not key-set slicing
+        keys = self.owned_keys_of(command)
+        t = prof.stage_begin() if prof is not None and prof.active else None
+        for key in keys:
+            dep_ids = key_deps.txn_ids_for_key(key) \
+                if key_deps is not None else None
+            cfk = cfks.get(key)
+            if cfk is None:
+                cfk = self.store._cfk(key)
+            fired = cfk.update(txn_id, status, execute_at, dep_ids=dep_ids)
+            if fired:
+                if t is not None:
+                    prof.stage_end(t, "cfk")
+                    t = None
+                for u in fired:
+                    u.callback(self)
+                if prof is not None and prof.active:
+                    t = prof.stage_begin()
+        if t is not None:
+            prof.stage_end(t, "cfk")
 
     def register_range_txn(self, command: Command, ranges: Ranges) -> None:
         self.store.range_version += 1
@@ -198,8 +223,9 @@ class SafeCommandStore:
     def _owned_cfk_keys(self, ranges: Ranges) -> List[Key]:
         """Data keys with conflict state inside `ranges` (the per-key walk a
         range txn makes over CommandsForKey, CommandsForKey.java range-txn
-        registration)."""
-        return sorted(k for k in self.store.cfks if ranges.contains(k))
+        registration).  Served by the store's maintained sorted key index —
+        two bisects per range instead of a full-dict scan per query."""
+        return self.store.cfk_keys_in(ranges)
 
     def _active_range_conflict(self, txn_id: TxnId, before: Timestamp,
                                kinds: KindSet) -> bool:
@@ -481,6 +507,12 @@ class CommandStore:
         self.safe_to_read: Ranges = ranges
         self.commands: Dict[TxnId, Command] = {}
         self.cfks: Dict[Key, CommandsForKey] = {}
+        # sorted index over cfks (tokens + keys in lockstep): CFKs are only
+        # ever created (never dropped — pruning empties them in place), so
+        # _cfk() maintains it exactly and range-bounded key queries bisect
+        # instead of scanning the whole dict (cfk_keys_in)
+        self._cfk_tokens: List[int] = []
+        self._cfk_keys: List[Key] = []
         self.tfks: Dict[Key, TimestampsForKey] = {}
         self.range_commands: Dict[TxnId, Ranges] = {}
         # bumped on any range_commands mutation (register/cleanup): the
@@ -511,6 +543,10 @@ class CommandStore:
         # harnesses whose node stub carries no obs facade
         obs = getattr(node, "obs", None)
         self.cpuprof = getattr(obs, "cpuprof", None)
+        # the flight ring, cached for the same reason as cpuprof: status
+        # transitions record per command transition and must not re-walk
+        # the node->obs->flight attribute chain each time
+        self._flight = getattr(obs, "flight", None)
 
     # -- environment plumbing --
     @property
@@ -521,8 +557,7 @@ class CommandStore:
     def flight(self):
         """The owning node's flight recorder (obs/flight.py); None on
         bare-store harnesses whose node stub carries no obs facade."""
-        obs = getattr(self.node, "obs", None)
-        return obs.flight if obs is not None else None
+        return self._flight
 
     @property
     def data_store(self):
@@ -546,7 +581,25 @@ class CommandStore:
         cfk = self.cfks.get(key)
         if cfk is None:
             cfk = self.cfks[key] = CommandsForKey(key)
+            i = bisect_left(self._cfk_tokens, key.token)
+            self._cfk_tokens.insert(i, key.token)
+            self._cfk_keys.insert(i, key)
         return cfk
+
+    def cfk_keys_in(self, ranges: Ranges) -> List[Key]:
+        """Sorted CFK keys inside `ranges`: two bisects per range over the
+        maintained index.  Ranges are normalized (sorted, disjoint), so the
+        concatenated slices are exactly
+        ``sorted(k for k in cfks if ranges.contains(k))``."""
+        toks = self._cfk_tokens
+        keys = self._cfk_keys
+        out: List[Key] = []
+        for r in ranges:
+            lo = bisect_left(toks, r.start)
+            hi = bisect_left(toks, r.end, lo)
+            if lo < hi:
+                out.extend(keys[lo:hi])
+        return out
 
     def _tfk(self, key: Key) -> TimestampsForKey:
         tfk = self.tfks.get(key)

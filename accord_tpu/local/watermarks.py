@@ -7,6 +7,7 @@ DurableBefore.java:39-180, all backed by ReducingRangeMap (SURVEY.md §2.3/§2.8
 from __future__ import annotations
 
 import enum
+from bisect import bisect_left, insort
 from typing import Optional, Tuple
 
 from accord_tpu.primitives.keys import Keys, Ranges, RoutingKey, _SortedKeyList
@@ -16,21 +17,41 @@ from accord_tpu.utils.interval_map import ReducingRangeMap
 
 class MaxConflicts:
     """token-range -> max conflict Timestamp; consulted for executeAt proposal
-    (MaxConflicts.java:28)."""
+    (MaxConflicts.java:28).
+
+    Split representation: single-key advances (every preaccept/commit of a
+    key txn — the host hot path) land in a plain token -> max dict with a
+    sorted-token sidecar for range folds, while range-shaped advances
+    (range txns / sync points) keep the immutable ReducingRangeMap.  A
+    query folds both; the old all-interval-map form rebuilt the whole
+    boundary tuple per key per commit."""
 
     def __init__(self):
         self._map: ReducingRangeMap = ReducingRangeMap()
+        self._points: dict = {}          # token -> max Timestamp
+        self._point_toks: list = []      # sorted tokens (range-fold sidecar)
 
     def get(self, participants) -> Optional[Timestamp]:
         """Max conflict over a Keys/Ranges selection."""
         best: Optional[Timestamp] = None
+        points = self._points
         if isinstance(participants, _SortedKeyList):
             for k in participants:
+                v = points.get(k.token)
+                if v is not None and (best is None or v > best):
+                    best = v
                 v = self._map.get(k.token)
                 if v is not None and (best is None or v > best):
                     best = v
         else:
+            toks = self._point_toks
             for r in participants:
+                lo = bisect_left(toks, r.start)
+                hi = bisect_left(toks, r.end, lo)
+                for i in range(lo, hi):
+                    v = points[toks[i]]
+                    if best is None or v > best:
+                        best = v
                 v = self._map.fold_max(r.start, r.end)
                 if v is not None and (best is None or v > best):
                     best = v
@@ -38,8 +59,16 @@ class MaxConflicts:
 
     def update(self, participants, ts: Timestamp) -> None:
         if isinstance(participants, _SortedKeyList):
+            points = self._points
+            toks = self._point_toks
             for k in participants:
-                self._map = self._map.update(k.token, k.token + 1, ts, max)
+                tok = k.token
+                cur = points.get(tok)
+                if cur is None:
+                    points[tok] = ts
+                    insort(toks, tok)
+                elif ts > cur:
+                    points[tok] = ts
         else:
             for r in participants:
                 self._map = self._map.update(r.start, r.end, ts, max)

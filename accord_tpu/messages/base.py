@@ -9,7 +9,7 @@ Callback.java / SafeCallback.java (executor-affine reply callbacks).
 from __future__ import annotations
 
 import enum
-from typing import Callable, Optional, TYPE_CHECKING
+from typing import Callable, Dict, Optional, TYPE_CHECKING
 
 from accord_tpu.primitives.keys import Ranges, Route
 from accord_tpu.primitives.timestamp import TxnId
@@ -137,16 +137,34 @@ class TxnRequest(Request):
     def wait_for_epoch(self) -> int:
         return self._wait_for_epoch or self.txn_id.epoch
 
+    # (id(route), id(owned)) -> (route, owned, scope): a coordination's 3-4
+    # rounds re-slice the SAME route object by the SAME memoized per-node
+    # Ranges per destination.  Values hold strong refs to both key objects
+    # (a live entry's ids cannot be recycled); bounded by wholesale clear.
+    _SCOPE_MEMO: Dict[tuple, tuple] = {}
+
     @staticmethod
     def compute_scope(to_node: int, topologies, route: Route) -> Optional[Route]:
         """Slice of `route` owned by `to_node` across the epoch window
         (TxnRequest.computeScope :259-270)."""
-        owned = Ranges.EMPTY
+        owned = None
         for topology in topologies:
-            owned = owned.union(topology.ranges_for_node(to_node))
-        if not route.intersects(owned):
-            return None
-        return route.slice(owned)
+            r = topology.ranges_for_node(to_node)
+            # single-epoch window (the common case): reuse the topology's
+            # memoized Ranges without a union copy + renormalize
+            owned = r if owned is None else owned.union(r)
+        if owned is None:
+            owned = Ranges.EMPTY
+        memo = TxnRequest._SCOPE_MEMO
+        key = (id(route), id(owned))
+        hit = memo.get(key)
+        if hit is not None and hit[0] is route and hit[1] is owned:
+            return hit[2]
+        scope = route.slice(owned) if route.intersects(owned) else None
+        if len(memo) > 1024:
+            memo.clear()
+        memo[key] = (route, owned, scope)
+        return scope
 
     def process(self, node: "Node", from_id: int, reply_context) -> None:
         node.map_reduce_consume_local(self, from_id, reply_context)

@@ -26,6 +26,8 @@ AVAILABLE = False
 _mod = None
 _wire_mod = None
 _wire_tried = False
+_cfk_mod = None
+_cfk_tried = False
 
 
 def _build_and_load(src_name: str, mod_name: str):
@@ -79,3 +81,21 @@ def get_wire():
             except Exception:  # noqa: BLE001 — Python tier fallback
                 _wire_mod = None
     return _wire_mod
+
+
+def get_cfk():
+    """The native CommandsForKey core (_cfk_core.cpp), or None (Python
+    tier).  Built lazily like the wire codec.  Tier selection:
+    ``ACCORD_NATIVE=0`` (the CFK-tier knob) or ``ACCORD_NO_NATIVE=1`` (the
+    package-wide kill switch) force the bit-identical Python tier; any
+    build/load failure degrades to it silently."""
+    global _cfk_mod, _cfk_tried
+    if not _cfk_tried:
+        _cfk_tried = True
+        if os.environ.get("ACCORD_NO_NATIVE", "") != "1" \
+                and os.environ.get("ACCORD_NATIVE", "") != "0":
+            try:
+                _cfk_mod = _build_and_load("_cfk_core.cpp", "_accord_cfk")
+            except Exception:  # noqa: BLE001 — Python tier fallback
+                _cfk_mod = None
+    return _cfk_mod

@@ -85,7 +85,7 @@ class CpuProfiler:
     __slots__ = ("registry", "sample_n", "enabled", "active", "_clock",
                  "_scale", "_tick", "_verb", "_t0", "_acc",
                  "_pending_decode", "_samples", "_totals", "_dispatches",
-                 "_sampled")
+                 "_sampled", "_stage_hists", "_total_hists")
 
     def __init__(self, registry, sample_n: int = 0, clock=None):
         self.registry = registry
@@ -103,6 +103,12 @@ class CpuProfiler:
         self._totals: Dict[str, List[float]] = {}              # verb->[us]
         self._dispatches: Dict[str, int] = {}                  # verb->count
         self._sampled = 0
+        # histogram handles cached per (verb, stage) / verb: dispatch_end
+        # runs per sampled dispatch and must not pay a labeled registry
+        # lookup per stage (the profiler's own overhead lands inside the
+        # very p50s it reports)
+        self._stage_hists: Dict[tuple, object] = {}
+        self._total_hists: Dict[str, object] = {}
 
     # -------------------------------------------------------- decode hook --
     def note_decode(self, dur_s: float) -> None:
@@ -166,15 +172,22 @@ class CpuProfiler:
             by_stage = self._samples[verb] = {}
         for stage, dur in acc.items():
             us = round(dur * scale * 1e6, 1)
-            reg.histogram("accord_cpu_stage_us", verb=verb,
-                          stage=stage).observe(us)
+            h = self._stage_hists.get((verb, stage))
+            if h is None:
+                h = self._stage_hists[(verb, stage)] = reg.histogram(
+                    "accord_cpu_stage_us", verb=verb, stage=stage)
+            h.observe(us)
             samples = by_stage.get(stage)
             if samples is None:
                 samples = by_stage[stage] = []
             if len(samples) < _MAX_SAMPLES:
                 samples.append(us)
         us_total = round(total * scale * 1e6, 1)
-        reg.histogram("accord_cpu_dispatch_us", verb=verb).observe(us_total)
+        h = self._total_hists.get(verb)
+        if h is None:
+            h = self._total_hists[verb] = reg.histogram(
+                "accord_cpu_dispatch_us", verb=verb)
+        h.observe(us_total)
         totals = self._totals.get(verb)
         if totals is None:
             totals = self._totals[verb] = []

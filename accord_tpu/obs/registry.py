@@ -143,14 +143,25 @@ class Registry:
         return self._get(self._histograms, Histogram, name, labels)
 
     def _get(self, table, cls, name, labels):
-        labels = {k: str(v) for k, v in labels.items()}
-        key = (name, _label_key(labels))
+        # canonical label string built without the per-call dict copy the
+        # hot paths used to pay (str-izing happens in the f-format; values
+        # are verbs/phases/ints, for which format == str); the full copy
+        # only runs on the miss path when the metric is created
+        if not labels:
+            lk = ""
+        elif len(labels) == 1:
+            (k, v), = labels.items()
+            lk = f"{k}={v}"
+        else:
+            lk = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+        key = (name, lk)
         m = table.get(key)
         if m is None:
             with self._lock:
                 m = table.get(key)
                 if m is None:
-                    m = table[key] = cls(name, labels)
+                    m = table[key] = cls(name, {k: str(v)
+                                                for k, v in labels.items()})
         return m
 
     # -------------------------------------------------------------- query --

@@ -22,6 +22,14 @@ No [B, E] scatter ever materialises.  The remaining [B, E] tile is fused
 broadcast-compares on the VPU plus one gather; XLA fuses the lot into a
 single pass over HBM.  The in-batch conflict graph (for the wavefront
 resolver) is one matmul on the MXU: share[b, b'] = touches @ touches.T > 0.
+
+PARITY: this batched path must stay bit-identical to the LIVE scalar
+CommandsForKey.map_reduce_active — which since ISSUE 10 is itself
+two-tiered (native/_cfk_core.cpp vs the pure-Python loops, selected by
+native.get_cfk()).  The scalar tiers are pinned identical to each other by
+tests/test_cfk_native.py, and this kernel is pinned against the live tier
+by the same suite's deps-kernel arm plus tests/test_device_store.py — so
+the equivalence chain is device == scalar-native == scalar-python.
 """
 
 from __future__ import annotations

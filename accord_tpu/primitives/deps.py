@@ -18,19 +18,39 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence,
 from accord_tpu.primitives.keys import Key, Keys, Range, Ranges, RoutingKey, RoutingKeys
 from accord_tpu.primitives.timestamp import TxnId
 from accord_tpu.utils import invariants
+from operator import attrgetter
+
 from accord_tpu.utils.sorted_arrays import (find_ceil, linear_merge_n,
                                             linear_union)
+
+# sort key for timestamp-like elements: C-level int compares on the packed
+# total-order key instead of Python-level __lt__ dispatch per comparison
+_CMP_KEY = attrgetter("_cmp")
 
 
 def _build_csr(sorted_lhs: Sequence, lhs_to_sets: Dict, sorted_rhs: Sequence
                ) -> Tuple[int, ...]:
-    """Build the [end-offsets..., value-indices...] CSR tail."""
-    rhs_index = {v: i for i, v in enumerate(sorted_rhs)}
+    """Build the [end-offsets..., value-indices...] CSR tail.
+
+    Timestamps index by their packed `_cmp` int (hash/eq on plain ints take
+    CPython's C fast path; the object forms dispatch to Python-level
+    __hash__/__eq__ per probe, which made this dict build a top profile
+    entry on the deps hot path).  Range lhs values have no `_cmp`; both
+    element kinds sort by the same total order either way."""
+    if sorted_rhs and hasattr(sorted_rhs[0], "_cmp"):
+        rhs_index = {v._cmp: i for i, v in enumerate(sorted_rhs)}
+        key_of = _CMP_KEY
+    else:
+        rhs_index = {v: i for i, v in enumerate(sorted_rhs)}
+        key_of = None
     offsets: List[int] = []
     values: List[int] = []
     for lhs in sorted_lhs:
-        ids = sorted(lhs_to_sets[lhs])
-        values.extend(rhs_index[t] for t in ids)
+        ids = sorted(lhs_to_sets[lhs], key=key_of)
+        if key_of is not None:
+            values.extend(rhs_index[t._cmp] for t in ids)
+        else:
+            values.extend(rhs_index[t] for t in ids)
         offsets.append(len(sorted_lhs) + len(values))
     return tuple(offsets + values)
 
@@ -55,7 +75,10 @@ class KeyDeps:
             self._map: Dict[Key, Set[TxnId]] = {}
 
         def add(self, key: Key, txn_id: TxnId) -> "KeyDeps.Builder":
-            self._map.setdefault(key, set()).add(txn_id)
+            s = self._map.get(key)
+            if s is None:
+                s = self._map[key] = set()
+            s.add(txn_id)
             return self
 
         def add_all(self, keys: Iterable[Key], txn_id: TxnId) -> "KeyDeps.Builder":
@@ -69,8 +92,16 @@ class KeyDeps:
         def build(self) -> "KeyDeps":
             if not self._map:
                 return KeyDeps.NONE
+            if len(self._map) == 1:
+                # single-key deps (the common shape of a key txn's
+                # calculate_deps): the CSR is the identity mapping
+                (k, ids), = self._map.items()
+                pool = tuple(sorted(ids, key=_CMP_KEY))
+                n = len(pool)
+                return KeyDeps(Keys((k,), _presorted=True), pool,
+                               (1 + n,) + tuple(range(n)))
             keys = Keys(self._map.keys())
-            all_ids = sorted(set().union(*self._map.values()))
+            all_ids = sorted(set().union(*self._map.values()), key=_CMP_KEY)
             csr = _build_csr(list(keys), self._map, all_ids)
             return KeyDeps(keys, tuple(all_ids), csr)
 
@@ -231,14 +262,15 @@ class KeyDeps:
         return self.without(lambda t: t in remove)
 
     def slice(self, ranges: Ranges) -> "KeyDeps":
+        owned = self.keys.slice(ranges)
+        if owned is self.keys or len(owned) == len(self.keys):
+            return self  # fully covered: one bisect pass, no span rebuild
         out_keys: List[Key] = []
         out_spans: List[List[int]] = []
-        for ki, k in enumerate(self.keys):
-            if ranges.contains(k):
-                out_keys.append(k)
-                out_spans.append(self._span_indices(ki))
-        if len(out_keys) == len(self.keys):
-            return self
+        for k in owned:
+            ki = self.keys.find(k)
+            out_keys.append(k)
+            out_spans.append(self._span_indices(ki))
         return KeyDeps._from_spans(out_keys, out_spans, self.txn_ids)
 
     @staticmethod
@@ -498,7 +530,10 @@ class Deps:
         return set(self.key_deps.txn_ids) | set(self.range_deps.txn_ids)
 
     def sorted_txn_ids(self) -> List[TxnId]:
-        return sorted(self.txn_id_set())
+        if not self.range_deps.txn_ids:
+            # key_deps.txn_ids is already the sorted unique pool
+            return list(self.key_deps.txn_ids)
+        return sorted(self.txn_id_set(), key=_CMP_KEY)
 
     def contains(self, txn_id: TxnId) -> bool:
         return self.key_deps.contains(txn_id) or self.range_deps.contains(txn_id)

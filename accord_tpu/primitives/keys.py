@@ -17,7 +17,7 @@ from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 from accord_tpu.utils import invariants
 from accord_tpu.utils.sorted_arrays import (
-    find_ceil, linear_intersection, linear_subtract, linear_union,
+    linear_intersection, linear_subtract, linear_union,
 )
 
 
@@ -60,7 +60,7 @@ class Key(RoutingKey):
 class _SortedKeyList:
     """Base for Keys/RoutingKeys: immutable sorted unique key sequence."""
 
-    __slots__ = ("_keys",)
+    __slots__ = ("_keys", "_tokens")
     _elem = RoutingKey
 
     def __init__(self, keys: Iterable[RoutingKey] = (), _presorted: bool = False):
@@ -68,6 +68,21 @@ class _SortedKeyList:
         if not _presorted:
             ks = sorted(set(ks), key=lambda k: k.token)
         self._keys: Tuple[RoutingKey, ...] = tuple(ks)
+        # parallel token tuple, built lazily: membership/slice queries then
+        # bisect over plain ints (one C call) instead of rich-compared key
+        # objects through the generic binary-search wrappers
+        self._tokens: Optional[Tuple[int, ...]] = None
+
+    def _tok(self) -> Tuple[int, ...]:
+        # try/except rather than a None test: wire-decoded instances may
+        # restore only the _keys slot, leaving this one unset
+        try:
+            t = self._tokens
+        except AttributeError:
+            t = None
+        if t is None:
+            t = self._tokens = tuple(k.token for k in self._keys)
+        return t
 
     # -- sequence protocol --
     def __len__(self): return len(self._keys)
@@ -92,13 +107,15 @@ class _SortedKeyList:
         return [k.token for k in self._keys]
 
     def contains(self, key: RoutingKey) -> bool:
-        i = find_ceil(self._keys, key)
-        return i < len(self._keys) and self._keys[i] == key
+        toks = self._tok()
+        i = bisect.bisect_left(toks, key.token)
+        return i < len(toks) and toks[i] == key.token
 
     def find(self, key: RoutingKey) -> int:
         """Index of key, or -(insertion)-1."""
-        i = find_ceil(self._keys, key)
-        if i < len(self._keys) and self._keys[i] == key:
+        toks = self._tok()
+        i = bisect.bisect_left(toks, key.token)
+        if i < len(toks) and toks[i] == key.token:
             return i
         return -(i + 1)
 
@@ -113,17 +130,29 @@ class _SortedKeyList:
         return type(self)(linear_subtract(self._keys, other._keys), _presorted=True)
 
     def slice(self, ranges: "Ranges") -> "_SortedKeyList":
+        toks = self._tok()
         out: List[RoutingKey] = []
         for r in ranges:
-            lo = find_ceil(self._keys, RoutingKey(r.start))
-            hi = find_ceil(self._keys, RoutingKey(r.end))
-            out.extend(self._keys[lo:hi])
+            lo = bisect.bisect_left(toks, r.start)
+            hi = bisect.bisect_left(toks, r.end, lo)
+            if lo < hi:
+                out.extend(self._keys[lo:hi])
+        if len(out) == len(self._keys):
+            return self  # fully covered: immutable, reuse
+        if not out:
+            cls = type(self)
+            empty = cls.__dict__.get("_EMPTY")
+            if empty is None:
+                empty = cls()
+                cls._EMPTY = empty
+            return empty
         return type(self)(out, _presorted=True)
 
     def intersects_ranges(self, ranges: "Ranges") -> bool:
+        toks = self._tok()
         for r in ranges:
-            lo = find_ceil(self._keys, RoutingKey(r.start))
-            if lo < len(self._keys) and self._keys[lo].token < r.end:
+            lo = bisect.bisect_left(toks, r.start)
+            if lo < len(toks) and toks[lo] < r.end:
                 return True
         return False
 
@@ -133,8 +162,23 @@ class _SortedKeyList:
         return acc
 
     def to_ranges(self) -> "Ranges":
-        """Minimal covering Ranges: one unit range per key."""
-        return Ranges([Range(k.token, k.token + 1) for k in self._keys])
+        """Minimal covering Ranges: one unit range per key, adjacent tokens
+        merged inline (exactly what normalization would produce, without
+        the per-key Range churn — this runs per destination per send via
+        Route.covering)."""
+        out: List[Range] = []
+        start = prev = None
+        for k in self._keys:
+            t = k.token
+            if prev is not None and t == prev + 1:
+                prev = t
+                continue
+            if prev is not None:
+                out.append(Range(start, prev + 1))
+            start = prev = t
+        if prev is not None:
+            out.append(Range(start, prev + 1))
+        return Ranges(out, _normalized=True)
 
 
 class Keys(_SortedKeyList):

@@ -83,10 +83,17 @@ class KindSet(frozenset):
         return kind in self
 
     def mask(self) -> int:
-        m = 0
-        for k in self:
-            m |= 1 << int(k)
-        return m
+        # cached per instance: the protocol's KindSets are the handful of
+        # module constants below, and the hot per-key scans test kind
+        # membership via this mask instead of a frozenset hash per entry
+        try:
+            return self._mask
+        except AttributeError:
+            m = 0
+            for k in self:
+                m |= 1 << int(k)
+            self._mask = m
+            return m
 
 
 WRITES = KindSet({TxnKind.WRITE, TxnKind.EXCLUSIVE_SYNC_POINT})
@@ -132,14 +139,18 @@ class Timestamp:
     reference's msb/lsb/node compare (Timestamp.java compareTo).
     """
 
-    __slots__ = ("epoch", "hlc", "flags", "node", "_cmp")
+    __slots__ = ("epoch", "hlc", "flags", "node", "_cmp", "_repr")
 
     def __init__(self, epoch: int, hlc: int, flags: int, node: int):
-        invariants.check_argument(0 <= epoch <= MAX_EPOCH, "epoch out of range")
-        invariants.check_argument(
-            hlc >> 64 == 0 and flags >> 16 == 0 and node >> 32 == 0
-            and hlc >= 0 and flags >= 0 and node >= 0,
-            "timestamp component out of packing range")
+        # inline packing-range validation (one branch, no helper-call
+        # frames): timestamps are constructed on every wire decode / HLC
+        # advance, so the two check_argument calls here were measurable
+        if (epoch | hlc | flags | node) < 0 or epoch > MAX_EPOCH \
+                or hlc >> 64 or flags >> 16 or node >> 32:
+            invariants.check_argument(0 <= epoch <= MAX_EPOCH,
+                                      "epoch out of range")
+            invariants.check_argument(
+                False, "timestamp component out of packing range")
         self.epoch = epoch
         self.hlc = hlc
         self.flags = flags
@@ -247,10 +258,9 @@ class TxnId(Timestamp):
         # validate kind bits at the source (unpack/wire paths take flags
         # verbatim): a lookup-table miss would otherwise surface later as a
         # silently thinner deps set.  flags == 0 is the NONE sentinel.
-        invariants.check_argument(
-            flags == 0
-            or _KIND_BY_INT[(flags & _KIND_MASK) >> _KIND_SHIFT] is not None,
-            "invalid TxnKind bits in flags %s", flags)
+        if flags and _KIND_BY_INT[(flags & _KIND_MASK) >> _KIND_SHIFT] is None:
+            invariants.check_argument(
+                False, "invalid TxnKind bits in flags %s", flags)
 
     @classmethod
     def create(cls, epoch: int, hlc: int, kind: TxnKind, domain: Domain,
@@ -301,10 +311,20 @@ class TxnId(Timestamp):
         return Timestamp(self.epoch, self.hlc, self.flags, self.node)
 
     def __repr__(self):
+        # cached lazily (unset slot -> AttributeError): the repr IS the
+        # trace/flight key, recomputed per status transition and span event
+        # for the same long-lived TxnId instance
+        try:
+            return self._repr
+        except AttributeError:
+            pass
         if self._cmp == 0:
-            return "TxnId.NONE"
-        return (f"{self.kind.name[0]}{'R' if self.is_range_domain else ''}"
-                f"[{self.epoch},{self.hlc},{self.node}]")
+            s = "TxnId.NONE"
+        else:
+            s = (f"{self.kind.name[0]}{'R' if self.is_range_domain else ''}"
+                 f"[{self.epoch},{self.hlc},{self.node}]")
+        self._repr = s
+        return s
 
 
 class Ballot(Timestamp):

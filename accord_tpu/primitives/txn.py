@@ -45,6 +45,13 @@ class Txn:
     # -- slicing (per-shard partials; Txn.slice) --
     def slice(self, ranges: Ranges, include_query: bool) -> "PartialTxn":
         keys = self.keys.slice(ranges)
+        if keys is self.keys and include_query \
+                and type(self) is PartialTxn:
+            # fully covered (Keys.slice returns the same object): the
+            # read/update key sets are subsets, so their slices are full
+            # too — reuse the immutable whole.  (A full Txn must still
+            # downgrade to a PartialTxn: callers merge partials via with_.)
+            return self
         return PartialTxn(
             self.kind, keys,
             read=self.read.slice(ranges) if self.read is not None else None,

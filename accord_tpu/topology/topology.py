@@ -11,7 +11,8 @@ from accord_tpu.utils import invariants
 
 
 class Topology:
-    __slots__ = ("epoch", "shards", "ranges", "_starts", "_node_shards")
+    __slots__ = ("epoch", "shards", "ranges", "_starts", "_node_shards",
+                 "_node_ranges", "_selection_memo")
 
     EMPTY: "Topology"
 
@@ -30,6 +31,16 @@ class Topology:
             for n in s.nodes:
                 node_shards.setdefault(n, []).append(i)
         self._node_shards = {n: tuple(ix) for n, ix in node_shards.items()}
+        # per-node Ranges memo: topologies are immutable and
+        # ranges_for_node runs per destination per message send
+        # (TxnRequest.compute_scope)
+        self._node_ranges: Dict[int, Ranges] = {}
+        # for_selection memo keyed by participant-object identity: a txn's
+        # coordination rounds re-select with the SAME route participants
+        # object 3-4 times per epoch window.  Values hold a strong ref to
+        # the key object, so a live entry's id cannot be reused; bounded by
+        # wholesale clear.
+        self._selection_memo: Dict[int, Tuple] = {}
 
     # -- basic accessors --
     @property
@@ -46,8 +57,12 @@ class Topology:
         return [self.shards[i] for i in self._node_shards.get(node, ())]
 
     def ranges_for_node(self, node: int) -> Ranges:
-        return Ranges([self.shards[i].range
-                       for i in self._node_shards.get(node, ())])
+        r = self._node_ranges.get(node)
+        if r is None:
+            r = self._node_ranges[node] = Ranges(
+                [self.shards[i].range
+                 for i in self._node_shards.get(node, ())])
+        return r
 
     def shard_for_key(self, key: RoutingKey) -> Optional[Shard]:
         i = bisect.bisect_right(self._starts, key.token) - 1
@@ -82,7 +97,15 @@ class Topology:
 
     def for_selection(self, select) -> "Topology":
         """Sub-topology of shards intersecting the selection (forSelection)."""
-        return Topology(self.epoch, self.shards_for(select))
+        memo = self._selection_memo
+        hit = memo.get(id(select))
+        if hit is not None and hit[0] is select:
+            return hit[1]
+        sub = Topology(self.epoch, self.shards_for(select))
+        if len(memo) > 256:
+            memo.clear()
+        memo[id(select)] = (select, sub)
+        return sub
 
     def for_node(self, node: int) -> "Topology":
         return Topology(self.epoch, self.shards_for_node(node))

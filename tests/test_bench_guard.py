@@ -248,7 +248,52 @@ def test_cpu_guard_exits_nonzero_on_synthetic_per_verb_slowdown(tmp_path):
                for e in lane["superseded"])
 
 
-def test_slo_journal_lane_guard_dry_run_validates_schema():
+# ----------------------- native CFK + apply-path cuts (ISSUE 10) --
+
+# the PR-9 recorded tcp-lane baseline this PR's claim is measured against
+# (BENCH_HISTORY.json tcp/host before the ISSUE-10 work; the row itself is
+# superseded by re-records, so the constants are frozen here)
+_PR9_TCP_BASELINE = {
+    # verb: (total-CPU p50 us, cfk-stage p50 us)
+    "STABLE_FAST_PATH_REQ": (195, 31),
+    "APPLY_MINIMAL_REQ": (154, 23),
+    "PRE_ACCEPT_REQ": (151, 37),
+}
+_CPU_GUARD_FLOOR_US = 20  # bench.py's default ACCORD_CPU_GUARD_FLOOR_US
+
+
+def test_issue10_tcp_cpu_row_improved_vs_pr9_baseline():
+    """ISSUE 10 acceptance, pinned against the live history: the recorded
+    tcp lane's per-verb total-CPU p50 must stay well below the PR-9
+    baseline for at least two of the three top verbs (the recorded row
+    shows -26..-33%; 0.85x here leaves re-record headroom on a noisy
+    box), and the `cfk` stage p50 must have improved for EVERY top verb —
+    or sit under the guard floor, below which the per-verb gate itself
+    does not fire."""
+    hist = json.load(open(os.path.join(
+        REPO, os.environ.get("ACCORD_BENCH_HISTORY", "BENCH_HISTORY.json"))))
+    entry = hist["tcp"]["host"]
+    verbs = entry["cpu"]["verbs"]
+    improved = 0
+    for verb, (base_total, base_cfk) in _PR9_TCP_BASELINE.items():
+        q = verbs[verb]
+        if q["p50_us"] <= 0.85 * base_total:
+            improved += 1
+        cfk = q["stages"]["cfk"]["p50_us"]
+        assert cfk <= base_cfk or cfk < _CPU_GUARD_FLOOR_US, (
+            f"{verb}: cfk stage p50 {cfk}us regressed vs the PR-9 "
+            f"baseline {base_cfk}us")
+    assert improved >= 2, (
+        f"fewer than two of {sorted(_PR9_TCP_BASELINE)} beat the PR-9 "
+        f"total-CPU p50 by >=15%: "
+        f"{ {v: verbs[v]['p50_us'] for v in _PR9_TCP_BASELINE} }")
+    # headline floor: the lane recorded 297 txn/s after ISSUE 10 (PR-9
+    # baseline row: 224.4); the coarse bound tolerates box-speed drift
+    # while still tripping on a real collapse
+    assert entry["value"] >= 230, entry["value"]
+
+
+
     """The durable-WAL SLO lane (fsync-stall arm's home) must carry a
     schema-valid exact-sample SLO row like every other slo-* lane."""
     proc = _run(["--config", "slo-journal", "--guard", "--dry-run"])

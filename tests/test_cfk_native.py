@@ -1,0 +1,289 @@
+"""Differential property suite: the native CommandsForKey core
+(native/_cfk_core.cpp) is bit-identical to the Python tier (ISSUE 10).
+
+Same precedent as the wire codec: the two tiers are never trusted
+separately — randomized CFK op sequences (update / apply_deps via dep_ids /
+map_reduce_active / register_historical / prune_redundant / unmanaged
+registrations) run once under each tier and every packed array, version
+counter, missing[] collection, wdeps cover set, committed view and scan
+output must match exactly.  A hostile burn arm runs the full nemesis stack
+with the native tier forced on, and the batched device/deps-kernel parity
+is exercised against whichever tier is live (tests/test_device_store.py
+runs under the ambient tier; here the scalar-vs-batched check is pinned
+explicitly with native on).
+"""
+
+import random
+
+import pytest
+
+from accord_tpu import native
+from accord_tpu.local import cfk as cfk_module
+from accord_tpu.local.cfk import CommandsForKey, InternalStatus, Unmanaged
+from accord_tpu.primitives.keys import Key
+from accord_tpu.primitives.timestamp import (Domain, Timestamp, TxnId,
+                                             TxnKind)
+
+pytestmark = pytest.mark.skipif(native.get_cfk() is None,
+                                reason="no C++ toolchain: native CFK "
+                                       "tier unavailable")
+
+KINDS = [TxnKind.READ, TxnKind.WRITE, TxnKind.SYNC_POINT,
+         TxnKind.EXCLUSIVE_SYNC_POINT]
+STATUSES = list(InternalStatus)
+
+
+def _gen_ops(seed, n_ops=140, pool_size=48, hlc_span=500):
+    """One randomized op tape, deterministic per seed, replayable against
+    either tier."""
+    rng = random.Random(seed)
+    pool = [TxnId.create(1, 100 + rng.randrange(hlc_span), rng.choice(KINDS),
+                         Domain.KEY, rng.randrange(4))
+            for _ in range(pool_size)]
+    ops = []
+    for _ in range(n_ops):
+        r = rng.random()
+        tid = rng.choice(pool)
+        if r < 0.50:
+            st = rng.choice(STATUSES)
+            eat = None
+            if rng.random() < 0.5:
+                eat = Timestamp(1, tid.hlc + rng.randrange(60), 0, tid.node)
+            deps = None
+            if st.has_info and rng.random() < 0.8:
+                deps = tuple(rng.sample(pool, rng.randrange(0, 14)))
+            ops.append(("update", tid, st, eat, deps))
+        elif r < 0.62:
+            ops.append(("hist", tid))
+        elif r < 0.72:
+            ops.append(("prune", rng.choice(pool)))
+        elif r < 0.82:
+            until = Timestamp(1, 100 + rng.randrange(hlc_span + 80), 0,
+                              rng.randrange(4))
+            ops.append(("unmanaged", tid, until))
+        else:
+            before = Timestamp(1, 100 + rng.randrange(hlc_span + 60), 0,
+                               rng.randrange(4))
+            ops.append(("scan", before, rng.choice(KINDS)))
+    return ops
+
+
+def _replay(ops, use_native):
+    saved = cfk_module._NATIVE
+    cfk_module._NATIVE = cfk_module._NATIVE if use_native else None
+    try:
+        cfk = CommandsForKey(Key(1))
+        outs = []
+        for op in ops:
+            if op[0] == "update":
+                _, tid, st, eat, deps = op
+                fired = cfk.update(tid, st, eat,
+                                   dep_ids=list(deps) if deps is not None
+                                   else None)
+                outs.append(("fired", [u.txn_id for u in fired]))
+            elif op[0] == "hist":
+                cfk.register_historical(op[1])
+            elif op[0] == "prune":
+                fired = cfk.prune_redundant(op[1])
+                outs.append(("pruned_fired", [u.txn_id for u in fired]))
+            elif op[0] == "unmanaged":
+                _, tid, until = op
+                # register only when something actually blocks, per the
+                # register_unmanaged caller contract
+                if cfk.blocking_ids(Unmanaged.APPLY, until, exclude=tid,
+                                    first_only=True):
+                    cfk.register_unmanaged(
+                        Unmanaged(tid, Unmanaged.APPLY, until,
+                                  lambda safe: None))
+                    outs.append(("registered", tid))
+            else:
+                _, before, kind = op
+                got = []
+                cfk.map_reduce_active(before, kind.witnesses(), got.append)
+                outs.append(("scan", got))
+        state = (list(cfk._ids), [int(s) for s in cfk._status],
+                 list(cfk._eat), list(cfk._missing), list(cfk._wdeps),
+                 list(cfk._committed), cfk.version, cfk.committed_version,
+                 cfk.redundant_before,
+                 sorted(w[2].txn_id for w in cfk._wait_heap))
+        return outs, state
+    finally:
+        cfk_module._NATIVE = saved
+
+
+_STATE_FIELDS = ("ids", "status", "eat", "missing", "wdeps", "committed",
+                 "version", "committed_version", "redundant_before",
+                 "pending_unmanaged")
+
+
+@pytest.mark.parametrize("seed", range(60))
+def test_differential_random_op_sequences(seed):
+    """Arrays, versions, missing[]/wdeps, the committed view, fired
+    unmanaged registrations and every scan output must match tier-for-tier
+    on the same op tape."""
+    ops = _gen_ops(seed)
+    n_outs, n_state = _replay(ops, use_native=True)
+    p_outs, p_state = _replay(ops, use_native=False)
+    assert n_outs == p_outs
+    for name, n_field, p_field in zip(_STATE_FIELDS, n_state, p_state):
+        assert n_field == p_field, f"tier divergence in {name}"
+
+
+def test_differential_dense_same_hlc_collisions():
+    """Hostile shape: a tiny hlc span forces heavy same-id updates, dep
+    self-references and dense missing[] traffic."""
+    for seed in range(20):
+        ops = _gen_ops(1000 + seed, n_ops=180, pool_size=16, hlc_span=30)
+        assert _replay(ops, True) == _replay(ops, False)
+
+
+def test_native_additions_insert_transitively_known():
+    """The additions path must insert unwitnessed dep ids exactly like the
+    Python tier: TRANSITIVELY_KNOWN placeholders with empty missing/wdeps,
+    and the same enum object in the status array."""
+    a = TxnId.create(1, 10, TxnKind.WRITE, Domain.KEY, 0)
+    b = TxnId.create(1, 20, TxnKind.WRITE, Domain.KEY, 1)
+    w = TxnId.create(1, 30, TxnKind.WRITE, Domain.KEY, 2)
+    cfk = CommandsForKey(Key(7))
+    cfk.update(w, InternalStatus.ACCEPTED, execute_at=w.as_timestamp(),
+               dep_ids=[a, b])
+    assert cfk.all_ids() == [a, b, w]
+    assert cfk._status[0] is InternalStatus.TRANSITIVELY_KNOWN
+    assert cfk._status[1] is InternalStatus.TRANSITIVELY_KNOWN
+    assert cfk.get(w).missing == ()
+    assert cfk._wdeps[2] == (a, b)
+    # TRANSITIVELY_KNOWN ids never become deps themselves
+    got = []
+    cfk.map_reduce_active(Timestamp(1, 99, 0, 0),
+                          TxnKind.WRITE.witnesses(), got.append)
+    assert got == [w]
+
+
+def test_native_missing_maintenance_matches_python():
+    """A late-witnessed id lands in every bounded has_info entry's
+    missing[] and leaves all of them on commit — both tiers, same bytes."""
+    def build(use_native):
+        saved = cfk_module._NATIVE
+        cfk_module._NATIVE = cfk_module._NATIVE if use_native else None
+        try:
+            cfk = CommandsForKey(Key(3))
+            late = TxnId.create(1, 15, TxnKind.WRITE, Domain.KEY, 0)
+            dep = TxnId.create(1, 5, TxnKind.WRITE, Domain.KEY, 1)
+            acc = TxnId.create(1, 40, TxnKind.WRITE, Domain.KEY, 2)
+            cfk.update(dep, InternalStatus.PREACCEPTED)
+            cfk.update(acc, InternalStatus.ACCEPTED,
+                       execute_at=Timestamp(1, 50, 0, 2), dep_ids=[dep])
+            cfk.update(late, InternalStatus.PREACCEPTED)   # diverges
+            missing_mid = [tuple(m) for m in cfk._missing]
+            cfk.update(late, InternalStatus.COMMITTED,
+                       execute_at=Timestamp(1, 45, 0, 0))  # elided again
+            return missing_mid, [tuple(m) for m in cfk._missing]
+        finally:
+            cfk_module._NATIVE = saved
+
+    n_mid, n_end = build(True)
+    p_mid, p_end = build(False)
+    assert n_mid == p_mid
+    assert n_end == p_end
+    assert any(m for m in n_mid), "late id never recorded as missing"
+    assert not any(m for m in n_end), "committed id not elided everywhere"
+
+
+def test_fallback_python_tier_when_disabled(monkeypatch):
+    """ACCORD_NATIVE=0 must force the Python tier through the loader (the
+    no-toolchain path takes the same branch)."""
+    import accord_tpu.native as native_pkg
+    monkeypatch.setenv("ACCORD_NATIVE", "0")
+    monkeypatch.setattr(native_pkg, "_cfk_tried", False)
+    monkeypatch.setattr(native_pkg, "_cfk_mod", None)
+    assert native_pkg.get_cfk() is None
+    # and a CFK driven with the module global cleared behaves identically
+    ops = _gen_ops(7)
+    assert _replay(ops, False) == _replay(ops, False)
+
+
+def test_store_key_index_matches_dict_scan():
+    """The maintained sorted CFK key index must agree with the full-dict
+    scan it replaced, for every query shape (empty, partial, covering)."""
+    from accord_tpu.local.store import CommandStore
+    from accord_tpu.primitives.keys import Ranges
+    rng = random.Random(11)
+    store = CommandStore(0, node=None, ranges=Ranges.of((0, 1000)))
+    for _ in range(120):
+        store._cfk(Key(rng.randrange(500)))
+    for lo, hi in ((0, 500), (10, 11), (100, 300), (499, 500), (600, 700)):
+        ranges = Ranges.of((lo, hi))
+        want = sorted(k for k in store.cfks if ranges.contains(k))
+        assert store.cfk_keys_in(ranges) == want
+    multi = Ranges.of((5, 50), (200, 280), (450, 900))
+    want = sorted(k for k in store.cfks if multi.contains(k))
+    assert store.cfk_keys_in(multi) == want
+    assert store.cfk_keys_in(Ranges.EMPTY) == []
+
+
+def test_deps_kernel_parity_with_native_tier_forced():
+    """The batched device deps kernel must stay bit-identical to the LIVE
+    scalar tier (ISSUE 10 satellite): random per-key histories, scalar
+    map_reduce_active under the native core vs ops/deps_kernel's batched
+    scan for a window of probes."""
+    jax = pytest.importorskip("jax")  # noqa: F841 — device tier optional
+    import numpy as np
+
+    from accord_tpu.ops.deps_kernel import batched_active_deps
+    from accord_tpu.ops.encode import BatchEncoder
+
+    assert cfk_module._NATIVE is not None
+    rng = random.Random(23)
+    keys = [Key(i) for i in range(6)]
+    cfks = [CommandsForKey(k) for k in keys]
+    statuses = [InternalStatus.PREACCEPTED, InternalStatus.ACCEPTED,
+                InternalStatus.COMMITTED, InternalStatus.STABLE,
+                InternalStatus.APPLIED]
+    for cfk in cfks:
+        hlc = 100
+        for _ in range(40):
+            hlc += 1 + rng.randrange(4)
+            tid = TxnId.create(1, hlc, rng.choice(KINDS), Domain.KEY,
+                               rng.randrange(3))
+            st = rng.choice(statuses)
+            eat = Timestamp(1, hlc + rng.randrange(8), 0, tid.node) \
+                if st.is_committed and rng.random() < 0.7 else None
+            cfk.update(tid, st, eat)
+    probes = []
+    for i in range(4):
+        before = TxnId.create(1, 320 + i * 7, TxnKind.WRITE, Domain.KEY, 2)
+        touched = rng.sample(keys, rng.randrange(1, len(keys)))
+        probes.append((before, before.kind.witnesses(), sorted(touched)))
+
+    enc = BatchEncoder.for_probes(cfks, probes)
+    s, b = enc.state, enc.dbatch
+    dep_mask, _ = batched_active_deps(
+        s.entry_rank, s.entry_eat_rank, s.entry_key, s.entry_status,
+        s.entry_kind, b.txn_rank, b.txn_witness_mask, b.touches)
+    got = enc.decode_key_deps(np.asarray(dep_mask))
+
+    for (before, kinds, touched), mapping in zip(probes, got):
+        want = {}
+        for key, cfk in zip(keys, cfks):
+            if key not in touched:
+                continue
+            out = []
+            cfk.map_reduce_active(before, kinds, out.append)
+            if out:
+                want[key] = out
+        assert mapping == want, f"probe {before!r} diverged"
+
+
+@pytest.mark.slow
+def test_hostile_burn_with_native_tier_forced():
+    """Hostile burn arm: the full nemesis stack must stay green with the
+    native CFK core live (any tier divergence surfaces as a checker
+    failure or replica-state audit divergence)."""
+    from accord_tpu.sim.burn import BurnRun
+    assert cfk_module._NATIVE is not None, \
+        "burn arm requires the native tier live"
+    run = BurnRun(913, 120, drop_prob=0.1, partitions=True,
+                  clock_drift=True)
+    stats = run.run()
+    assert stats.acks > 0, "pathological: no transaction succeeded"
+    assert stats.lost == 0 and stats.pending == 0

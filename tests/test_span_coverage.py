@@ -223,6 +223,61 @@ def test_loop_health_kinds_are_covered():
             f"host/{host_file} lost its LoopHealth wiring"
 
 
+def test_cfk_fence_survives_tier_swaps():
+    """ISSUE 10: the protocol-CPU `cfk` stage fence must hold whichever
+    CommandsForKey tier is live.  Statically, the fence literals live in
+    the TIER-INDEPENDENT layers (local/store.py registration walk,
+    local/commands.py deps calc) and local/cfk.py itself must carry NO
+    fence — a fence inside the tier-dispatched methods could vanish with
+    a tier swap.  Dynamically, a sampled dispatch driving a real store
+    registration must record cfk-stage time under BOTH tiers."""
+    for rel, wanted in (("local/store.py", True), ("local/commands.py", True),
+                        ("local/cfk.py", False)):
+        src = open(os.path.join(ROOT, *rel.split("/"))).read()
+        has = 'stage_end(t, "cfk")' in src
+        assert has == wanted, (
+            f"{rel}: cfk fence {'missing' if wanted else 'present'} — the "
+            f"fence must bracket the tier dispatch, not live inside a tier")
+
+    from types import SimpleNamespace
+
+    from accord_tpu.local import cfk as cfk_module
+    from accord_tpu.local.cfk import InternalStatus
+    from accord_tpu.local.command import Command
+    from accord_tpu.local.store import (CommandStore, PreLoadContext,
+                                        SafeCommandStore)
+    from accord_tpu.obs.cpuprof import CpuProfiler
+    from accord_tpu.obs.registry import Registry
+    from accord_tpu.primitives.keys import (Ranges, Route, RoutingKey,
+                                            RoutingKeys)
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    for tier in ("native", "python"):
+        saved = cfk_module._NATIVE
+        if tier == "python":
+            cfk_module._NATIVE = None
+        elif saved is None:
+            continue  # no toolchain: the python arm still ran
+        try:
+            prof = CpuProfiler(Registry(), sample_n=1)
+            node = SimpleNamespace(obs=SimpleNamespace(cpuprof=prof,
+                                                       flight=None))
+            store = CommandStore(0, node, Ranges.of((0, 100)))
+            safe = SafeCommandStore(store, PreLoadContext.empty())
+            tid = TxnId.create(1, 50, TxnKind.WRITE, Domain.KEY, 1)
+            cmd = Command(tid)
+            cmd.route = Route.of_keys(RoutingKey(7), RoutingKeys.of(7))
+            assert prof.dispatch_begin("X_REQ")
+            safe.register(cmd, InternalStatus.PREACCEPTED)
+            prof.dispatch_end()
+            cpu = prof.export()
+            stages = cpu["stages"]["X_REQ"]
+            assert "cfk" in stages and len(stages["cfk"]) == 1, (
+                f"{tier} tier: registration lost the cfk stage fence")
+        finally:
+            cfk_module._NATIVE = saved
+
+
 def test_journal_lifecycle_kinds_are_covered():
     """The durable WAL's full lifecycle must stay on the forensics ring:
     append, segment rotation, snapshot compaction, and both replay edges.
