@@ -341,9 +341,31 @@ class LocalConfig:
     # livelocked-but-chatty coordination still fails in bounded time
     coordination_watchdog_hard_cap_multiplier: float = 10.0
     bootstrap_retry_delay_s: float = 1.0
+    # bootstrap robustness (local/bootstrap.py, impl/fetch_coordinator.py):
+    # per-source snapshot-fetch timeout, bounded attempt count with
+    # exponential backoff (delay = retry_delay * 2^(attempt-1), capped)
+    bootstrap_fetch_timeout_s: float = 10.0
+    bootstrap_max_retries: int = 8
+    bootstrap_retry_delay_cap_s: float = 30.0
     durability_shard_cycle_s: float = 30.0
     durability_global_cycle_every: int = 4
 
     @classmethod
     def default(cls) -> "LocalConfig":
-        return cls()
+        """Defaults with the host env knobs applied
+        (ACCORD_BOOTSTRAP_TIMEOUT_US / ACCORD_BOOTSTRAP_RETRIES)."""
+        import os
+        cfg = cls()
+        try:
+            us = int(os.environ.get("ACCORD_BOOTSTRAP_TIMEOUT_US", "0"))
+            if us > 0:
+                cfg.bootstrap_fetch_timeout_s = us / 1e6
+        except ValueError:
+            pass
+        try:
+            retries = int(os.environ.get("ACCORD_BOOTSTRAP_RETRIES", "0"))
+            if retries > 0:
+                cfg.bootstrap_max_retries = retries
+        except ValueError:
+            pass
+        return cfg

@@ -156,6 +156,7 @@ class MaelstromHost:
         self.metrics_server = None  # built with the node (obs/httpd)
         self.auditor = None         # built with the node (local/audit.py)
         self.loop_health = None     # built with the node (obs/cpuprof.py)
+        self.config_service = None  # built with the node (admin epoch plane)
         self.node_name = ""
         self.names: Dict[int, str] = {}
         self.scheduler = RealTimeScheduler()
@@ -203,7 +204,15 @@ class MaelstromHost:
         self.loop_health = LoopHealth(self.node.obs.registry,
                                       self.node.obs.flight)
         self.scheduler.lag_observer = self.loop_health.timer_lag
-        self.node.on_topology_update(topology)
+        # topology flows through a real ConfigurationService (same layer
+        # the TCP host wires): admin_epoch installs gossip over ordinary
+        # "accord" envelopes and gaps heal via TOPOLOGY_FETCH
+        from accord_tpu.impl.config_service import LedgerConfigService
+        from accord_tpu.messages.admin import EpochInstall
+        self.config_service = LedgerConfigService(my_id)
+        self.config_service.attach_node(self.node)
+        self.config_service.remember_spec(EpochInstall.from_topology(topology))
+        self.config_service.report_topology(topology)
         # ACCORD_JOURNAL=<dir>: replay surviving state from
         # <dir>/node-<id>, then journal every side-effecting request before
         # it is acked (group-commit fsync windows; see journal/wal.py)
@@ -253,6 +262,24 @@ class MaelstromHost:
                 "msg_id": body.get("msg_id"),
                 "type": "txn",
                 "txn": [["r", k, None] for k in body["keys"]]})
+        elif typ == "admin_epoch":
+            # admin plane: propose a topology epoch over the Maelstrom
+            # transport — journaled before the ack, gossiped so one
+            # contacted node converges the whole membership
+            self._handle_admin_epoch(src, body)
+
+    def _handle_admin_epoch(self, client: str, body: dict) -> None:
+        from accord_tpu.messages.admin import EpochInstall
+        spec = body.get("topology", {})
+        install = EpochInstall(
+            int(spec["epoch"]),
+            [(s[0], s[1], tuple(s[2])) for s in spec["shards"]])
+        self.node.receive(install, 0, None)
+        if self.wal is not None:
+            self.wal.sync()  # persist-before-ack
+        self._emit(client, {"type": "admin_epoch_ok",
+                            "in_reply_to": body.get("msg_id"),
+                            "epoch": self.node.epoch})
 
     def _handle_txn(self, client: str, body: dict) -> None:
         ops = body["txn"]

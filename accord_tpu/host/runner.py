@@ -169,6 +169,42 @@ class MaelstromRunner:
         ok = self.pump_until(lambda: name in self.init_acks, deadline_s)
         assert ok, f"restarted {name} never re-initialized"
 
+    # --------------------------------------------------------- admin plane --
+    def install_epoch(self, epoch: int, shards, to: Optional[str] = None,
+                      deadline_s: float = 30.0) -> dict:
+        """Admin-plane epoch proposal over the Maelstrom transport:
+        `shards` is [[start, end, [node_num, ...]], ...].  One contacted
+        node journals the install before acking and gossips it to the rest
+        (admin_epoch_ok carries the contact's post-install epoch)."""
+        self._msg_seq += 1
+        msg_id = self._msg_seq
+        dest = to if to is not None else self.names[0]
+        acked: List[dict] = []
+        self.pending[msg_id] = {"msg_id": msg_id, "client": "c0",
+                                "ops": [], "start_us": 0, "reply": None}
+        self.procs[dest].send({
+            "src": "c0", "dest": dest,
+            "body": {"type": "admin_epoch", "msg_id": msg_id,
+                     "topology": {
+                         "epoch": int(epoch),
+                         "shards": [[int(s), int(e),
+                                     [int(n) for n in nodes]]
+                                    for s, e, nodes in shards]}}})
+
+        def got_ack() -> bool:
+            rec = next((r for r in self.results
+                        if r["msg_id"] == msg_id), None)
+            if rec is not None:
+                acked.append(rec)
+            return bool(acked)
+
+        ok = self.pump_until(got_ack, deadline_s)
+        assert ok, f"admin_epoch {epoch} never acked by {dest}"
+        rec = acked[0]
+        self.results.remove(rec)
+        assert rec["reply"]["type"] == "admin_epoch_ok", rec["reply"]
+        return rec["reply"]
+
     # ------------------------------------------------------------- client --
     def init_all(self) -> None:
         for name, hp in self.procs.items():

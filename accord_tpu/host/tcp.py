@@ -305,12 +305,18 @@ class _PeerLane:
 
     # ------------------------------------------------------- connection --
     def _connect(self) -> None:
+        addr = self.host.peers.get(self.to)
+        if addr is None:
+            # peer address not (yet) known — an epoch install's `peers`
+            # spec teaches it; until then back off like a dead link
+            self._fail()
+            return
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setblocking(False)
         self.sock = sock
         self.connecting = True
         try:
-            rc = sock.connect_ex(self.host.peers[self.to])
+            rc = sock.connect_ex(addr)
         except OSError:
             self._fail()
             return
@@ -506,7 +512,8 @@ class TcpHost:
     (node_id -> (host, port), including itself)."""
 
     def __init__(self, my_id: int, peers: Dict[int, Tuple[str, int]],
-                 rf: Optional[int] = None, n_shards: int = 4):
+                 rf: Optional[int] = None, n_shards: int = 4,
+                 topology_ids: Optional[List[int]] = None):
         self.my_id = my_id
         self.peers = dict(peers)
         self._loop_tid: Optional[int] = None  # set once the loop starts:
@@ -548,8 +555,13 @@ class TcpHost:
 
         # non-positive ids are CLIENT endpoints: they share the frame
         # transport (their replies travel as ordinary frames to their own
-        # listening socket) but are not cluster members
-        ids = sorted(i for i in self.peers if i > 0)
+        # listening socket) but are not cluster members.  `topology_ids`
+        # pins which members form EPOCH 1: a node joining an established
+        # cluster mid-run (scale-out) must build the same genesis topology
+        # the founders did — one that does NOT include it — and acquire
+        # its ranges only through the epoch that assigns them.
+        ids = (sorted(topology_ids) if topology_ids
+               else sorted(i for i in self.peers if i > 0))
         rf = rf if rf is not None else min(3, len(ids))
         topology = build_topology(ids, rf, n_shards)
 
@@ -570,7 +582,17 @@ class TcpHost:
         from accord_tpu.obs.cpuprof import LoopHealth
         self.loop_health = LoopHealth(self.node.obs.registry, self.flight)
         self.scheduler.lag_observer = self.loop_health.timer_lag
-        self.node.on_topology_update(topology)
+        # topology flows through a real ConfigurationService (the admin
+        # plane's epoch ledger): installs gossip peer-to-peer, gaps heal
+        # via TOPOLOGY_FETCH, and `peers` specs riding an install teach
+        # this transport new nodes' addresses (scale-out)
+        from accord_tpu.impl.config_service import LedgerConfigService
+        from accord_tpu.messages.admin import EpochInstall
+        self.config_service = LedgerConfigService(
+            my_id, peers_hook=self._merge_peers)
+        self.config_service.attach_node(self.node)
+        self.config_service.remember_spec(EpochInstall.from_topology(topology))
+        self.config_service.report_topology(topology)
 
         # ACCORD_JOURNAL=<dir>: durable write-ahead journal under
         # <dir>/node-<id> — existing state replays into the node BEFORE any
@@ -941,6 +963,26 @@ class TcpHost:
             if from_id <= 0:
                 self.running = False
             return
+        if kind == "epoch":
+            # admin plane: propose a topology epoch (journaled before the
+            # ack; gossips to every member, so ONE admin contact suffices)
+            if from_id <= 0:
+                self._admin_epoch(from_id, body)
+            return
+        if kind == "topology":
+            # routing refresh for clients: the current topology spec
+            if from_id <= 0:
+                self.emit(from_id, {"type": "topology_reply",
+                                    "req": body.get("req"),
+                                    "node": self.my_id,
+                                    "topology": self._topology_spec()})
+            return
+        if kind == "drain":
+            # admin plane: scale-in — fence, hand off, wait durability,
+            # retire without losing an ack
+            if from_id <= 0:
+                self._admin_drain(from_id, body)
+            return
         payload = body["payload"]
         if type(payload) is dict:
             # tree payload (JSON frame or Python-tier unpack): decode here;
@@ -960,8 +1002,96 @@ class TcpHost:
         else:
             self.node.receive(payload, from_id, body.get("msg_id"))
 
+    # -------------------------------------------------------- admin plane --
+    def _merge_peers(self, peers) -> None:
+        """An epoch install's `peers` spec taught us addresses (a node
+        joining in that epoch): merge them so lazily-created lanes can
+        connect.  Loop thread (installs arrive via dispatch)."""
+        for nid, host, port in peers:
+            if int(nid) != self.my_id:
+                self.peers[int(nid)] = (host, int(port))
+
+    def _topology_spec(self) -> dict:
+        topo = self.node.topology.current()
+        return {"epoch": topo.epoch,
+                "shards": [[s.range.start, s.range.end,
+                            list(s.sorted_nodes)] for s in topo.shards]}
+
+    def _admin_epoch(self, from_id: int, body: dict) -> None:
+        """`{"type":"epoch","topology":{...}}`: build the EpochInstall and
+        feed it through normal dispatch — journaled (has_side_effects)
+        BEFORE the ack below, applied via the config service's immutable
+        topology swap, then gossiped to every member."""
+        from accord_tpu.messages.admin import EpochInstall
+        spec = body.get("topology", {})
+        peers = spec.get("peers")
+        install = EpochInstall(
+            int(spec["epoch"]),
+            [(s[0], s[1], tuple(s[2])) for s in spec["shards"]],
+            peers=[tuple(p) for p in peers] if peers else None)
+        self.node.receive(install, 0, None)
+        if self.wal is not None:
+            self.wal.sync()  # persist-before-ack: the install survives us
+        self.emit(from_id, {"type": "epoch_ok", "req": body.get("req"),
+                            "node": self.my_id, "epoch": self.node.epoch})
+
+    def _admin_drain(self, from_id: int, body: dict) -> None:
+        """`{"type":"drain"}`: scale-in this node.  DrainBegin fences new
+        client coordination (journaled: a crashed drainer comes back
+        fenced) and tells peers to deprioritize us as a fetch source; then
+        we wait for in-flight coordinations to settle, raise a GLOBAL_SYNC
+        durability barrier over our ranges, and only then ack + DrainDone."""
+        from accord_tpu.messages.admin import DrainBegin, DrainDone
+        node = self.node
+        req = body.get("req")
+        topology = node.topology.current()
+        members = sorted(n for n in topology.nodes() if n != self.my_id)
+        node.receive(DrainBegin(self.my_id), 0, None)
+        for to in members:
+            node.send(to, DrainBegin(self.my_id))
+        deadline = time.monotonic() + float(body.get("timeout_s", 60.0))
+
+        def finish(_v=None, failure=None):
+            node.receive(DrainDone(self.my_id), 0, None)
+            for to in members:
+                node.send(to, DrainDone(self.my_id))
+            if self.wal is not None:
+                self.wal.sync()  # every acked write is on disk before we go
+            self.emit(from_id, {"type": "drain_ok", "req": req,
+                                "node": self.my_id,
+                                "durable": failure is None})
+
+        def durability_barrier():
+            owned = topology.ranges_for_node(self.my_id)
+            if owned.is_empty:
+                # the current epoch already moved everything away; older
+                # in-flight work still needs the watermark — barrier all
+                from accord_tpu.primitives.keys import Ranges
+                owned = Ranges([s.range for s in topology.shards])
+            from accord_tpu.coordinate.syncpoint import BarrierType, barrier
+            barrier(node, owned, BarrierType.GLOBAL_SYNC) \
+                .add_callback(finish)
+
+        self._drain_wait_idle(durability_barrier, deadline)
+
+    def _drain_wait_idle(self, then, deadline: float) -> None:
+        """Hand off in-flight work: poll until nothing this node is
+        coordinating remains (new client work is already fenced)."""
+        if not self.node.coordinating or time.monotonic() >= deadline:
+            then()
+            return
+        self.scheduler.once(0.05,
+                            lambda: self._drain_wait_idle(then, deadline))
+
     def _client_submit(self, from_id: int, body: dict) -> None:
         req = body.get("req")
+        if self.node.draining:
+            # drain fence: never coordinated, safe for the client to remap
+            # to another coordinator (openloop counts these as shed)
+            self.emit(from_id, {"type": "submit_reply", "req": req,
+                                "ok": False, "error": "draining",
+                                "shed": True, "drained": True})
+            return
         want_phases = bool(body.get("phases"))
 
         def done(value, failure):
@@ -1073,6 +1203,12 @@ class TcpClusterClient:
         import sys as _sys
         ports = _free_ports(n_nodes + 1)
         self.peers = {i: ("127.0.0.1", ports[i]) for i in range(n_nodes + 1)}
+        self.n_shards = n_shards
+        # the founding membership: nodes added later (add_node) must build
+        # the founders' epoch-1 topology, not one that includes themselves
+        self._seed_ids = list(range(1, n_nodes + 1))
+        # routing spec cache for owner_of (refresh_topology updates it)
+        self.topology_spec: Optional[dict] = None
         self.server = socket.create_server(self.peers[0], reuse_port=False)
         self.inbox: "queue.Queue" = queue.Queue()
         self.running = True
@@ -1097,6 +1233,10 @@ class TcpClusterClient:
                 p.kill()
             raise
         self._out: Dict[int, socket.socket] = {}
+        # one client endpoint may be driven from two threads (the open-loop
+        # pacer and the reshard admin driver): serialize socket writes so
+        # frames never interleave mid-write
+        self._send_lock = threading.Lock()
 
     def _accept_loop(self) -> None:
         while self.running:
@@ -1125,12 +1265,13 @@ class TcpClusterClient:
             return
 
     def _send(self, to: int, body: dict) -> None:
-        sock = self._out.get(to)
-        if sock is None:
-            sock = self._out[to] = socket.create_connection(self.peers[to],
-                                                            timeout=10.0)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_frame(sock, {"src": 0, "body": body})
+        with self._send_lock:
+            sock = self._out.get(to)
+            if sock is None:
+                sock = self._out[to] = socket.create_connection(
+                    self.peers[to], timeout=10.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_frame(sock, {"src": 0, "body": body})
 
     def submit(self, to: int, reads, appends: Dict[int, int], req,
                ephemeral: bool = False, want_phases: bool = False) -> None:
@@ -1229,6 +1370,172 @@ class TcpClusterClient:
                 return body.get("top")
         return None
 
+    # ------------------------------------------------------ live elasticity --
+    def fetch_topology(self, to: int, timeout_s: float = 15.0
+                       ) -> Optional[dict]:
+        """Pull node `to`'s current topology spec over the frame transport
+        (same quiet-channel caveat as fetch_metrics)."""
+        req = f"topology-{to}-{time.monotonic_ns()}"
+        try:
+            self._send(to, {"type": "topology", "req": req})
+        except OSError:
+            return None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = self.recv(min(1.0, timeout_s))
+            if got is None:
+                continue
+            body = got.get("body", {})
+            if body.get("type") == "topology_reply" \
+                    and body.get("req") == req:
+                return body.get("topology")
+        return None
+
+    def refresh_topology(self, contact: int = 1,
+                         timeout_s: float = 15.0) -> Optional[dict]:
+        """Re-learn routing after a reshard: without this the client keeps
+        submitting against the pre-reshard ownership map forever (the
+        static-topology caching bug the elasticity lane pins)."""
+        spec = self.fetch_topology(contact, timeout_s=timeout_s)
+        if spec is not None:
+            self.topology_spec = spec
+        return spec
+
+    def owner_of(self, token: int) -> int:
+        """First replica of the shard owning `token` under the freshest
+        topology spec this client fetched (node 1 before any refresh)."""
+        spec = self.topology_spec
+        if spec:
+            for start, end, nodes in spec["shards"]:
+                if start <= token < end and nodes:
+                    return nodes[0]
+        return 1
+
+    def install_epoch(self, epoch: int, shards, peers=None, contact: int = 1,
+                      timeout_s: float = 30.0) -> Optional[dict]:
+        """Admin-plane epoch proposal: `shards` is [[start, end, [nodes]],
+        ...], `peers` optionally [[id, host, port], ...] for members joining
+        in this epoch.  One contact suffices — the install is journaled
+        there before the ack and gossips to every member."""
+        req = f"epoch-{epoch}-{contact}"
+        topo = {"epoch": int(epoch),
+                "shards": [[int(s), int(e), [int(n) for n in nodes]]
+                           for s, e, nodes in shards]}
+        if peers:
+            topo["peers"] = [[int(i), str(h), int(p)] for i, h, p in peers]
+        self._send(contact, {"type": "epoch", "req": req, "topology": topo})
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = self.recv(min(1.0, timeout_s))
+            if got is None:
+                continue
+            body = got.get("body", {})
+            if body.get("type") == "epoch_ok" and body.get("req") == req:
+                return body
+        return None
+
+    def wait_epoch(self, epoch: int, nodes=None,
+                   timeout_s: float = 30.0) -> bool:
+        """Poll topology frames until every node in `nodes` (default: all)
+        reports `epoch` (installed via gossip/fetch, one admin contact)."""
+        remaining = set(nodes if nodes is not None
+                        else range(1, len(self.procs) + 1))
+        deadline = time.monotonic() + timeout_s
+        while remaining and time.monotonic() < deadline:
+            for n in sorted(remaining):
+                spec = self.fetch_topology(n, timeout_s=5.0)
+                if spec is not None and spec.get("epoch", 0) >= epoch:
+                    remaining.discard(n)
+            if remaining:
+                time.sleep(0.1)
+        return not remaining
+
+    def add_node(self, cpu: Optional[int] = None) -> int:
+        """Spawn a fresh journal-backed worker joining the live cluster.
+        It builds the founders' epoch-1 topology (owning nothing) and only
+        acquires ranges once an installed epoch assigns them — at which
+        point it bootstraps over this same transport.  Returns its id."""
+        import json as _json
+        import subprocess
+        import sys as _sys
+        node_id = len(self.procs) + 1  # ids stay contiguous for close()
+        (port,) = _free_ports(1)
+        self.peers[node_id] = ("127.0.0.1", port)
+        spec_peers = {str(i): list(p) for i, p in self.peers.items()}
+        spec = {"id": node_id, "peers": spec_peers,
+                "n_shards": self.n_shards,
+                "topology_ids": list(self._seed_ids)}
+        if cpu is not None:
+            spec["cpu"] = cpu
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "accord_tpu.host.tcp",
+             _json.dumps(spec)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        self.procs.append(proc)
+        line = proc.stdout.readline()  # ready marker
+        assert line.strip(), "tcp worker failed to start"
+        return node_id
+
+    def peer_specs(self, ids=None):
+        """[[id, host, port], ...] for an install_epoch peers field."""
+        return [[i, self.peers[i][0], self.peers[i][1]]
+                for i in (ids if ids is not None else sorted(
+                    n for n in self.peers if n > 0))]
+
+    def drain_node(self, node_id: int,
+                   timeout_s: float = 60.0) -> Optional[dict]:
+        """Retire `node_id`: fence new coordination there, let in-flight
+        work hand off, wait the durability watermark, then ack."""
+        req = f"drain-{node_id}"
+        try:
+            self._send(node_id, {"type": "drain", "req": req,
+                                 "timeout_s": timeout_s})
+        except OSError:
+            return None
+        deadline = time.monotonic() + timeout_s + 10.0
+        while time.monotonic() < deadline:
+            got = self.recv(min(1.0, timeout_s))
+            if got is None:
+                continue
+            body = got.get("body", {})
+            if body.get("type") == "drain_ok" and body.get("req") == req:
+                return body
+        return None
+
+    def kill_node(self, node_id: int) -> None:
+        """Process-death nemesis arm: SIGKILL the worker (its journal
+        survives; restart_node brings it back from the WAL)."""
+        self.procs[node_id - 1].kill()
+        self.procs[node_id - 1].wait(timeout=10.0)
+        sock = self._out.pop(node_id, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def restart_node(self, node_id: int,
+                     topology_ids=None) -> None:
+        """Respawn a killed worker on its original port: it replays its
+        journal (epoch installs + bootstrap checkpoints included) before
+        serving, resuming any interrupted bootstrap from the checkpointed
+        coverage."""
+        import json as _json
+        import subprocess
+        import sys as _sys
+        spec_peers = {str(i): list(p) for i, p in self.peers.items()}
+        spec = {"id": node_id, "peers": spec_peers,
+                "n_shards": self.n_shards,
+                "topology_ids": list(topology_ids if topology_ids is not None
+                                     else self._seed_ids)}
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "accord_tpu.host.tcp",
+             _json.dumps(spec)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        self.procs[node_id - 1] = proc
+        line = proc.stdout.readline()
+        assert line.strip(), "tcp worker failed to restart"
+
     def close(self) -> None:
         for i in range(1, len(self.procs) + 1):
             try:
@@ -1268,7 +1575,8 @@ def main() -> None:
             pass  # fewer cores than nodes: scheduling still works
     peers = {int(k): tuple(v) for k, v in spec["peers"].items()}
     host = TcpHost(spec["id"], peers, rf=spec.get("rf"),
-                   n_shards=spec.get("n_shards", 4))
+                   n_shards=spec.get("n_shards", 4),
+                   topology_ids=spec.get("topology_ids"))
     print(_json.dumps({"id": spec["id"],
                        "port": host.peers[spec["id"]][1]}), flush=True)
 

@@ -71,12 +71,21 @@ class EpochHistory:
 
 
 class AbstractConfigurationService(ConfigurationService):
+    # epoch-install gossip pacing: resend the install to topology members
+    # that have not reported sync-complete, once per interval, for a
+    # bounded number of rounds (partition-heal convergence without an
+    # unbounded background chatter)
+    GOSSIP_INTERVAL_S = 1.0
+    GOSSIP_ROUNDS = 30
+
     def __init__(self, local_id: int):
         self.local_id = local_id
         self.epochs = EpochHistory()
         self.listeners: List = []
         self._fetching: Dict[int, bool] = {}
         self._delivered = 0  # highest epoch fanned out to listeners
+        self.node = None          # set by attach_node
+        self._specs: Dict[int, object] = {}  # epoch -> EpochInstall spec
 
     # ---------------------------------------------------------------- query --
     def current_topology(self):
@@ -99,6 +108,58 @@ class AbstractConfigurationService(ConfigurationService):
         forever on gossip that may be lost."""
         self.register_listener(node)
         node.topology.set_fetch_hook(self.fetch_topology_for_epoch)
+        self.node = node
+        node.config_service = self
+
+    # ---------------------------------------------------------- admin plane --
+    def spec_for(self, epoch: int):
+        """The EpochInstall spec this service witnessed for `epoch` (serves
+        TopologyFetchReq gap fetches), or None."""
+        return self._specs.get(epoch)
+
+    def remember_spec(self, install) -> None:
+        """Record an install spec without (re)applying it — used for the
+        startup epoch, which is built locally rather than received."""
+        self._specs.setdefault(install.epoch, install)
+
+    def on_epoch_install(self, install, from_id: int) -> bool:
+        """One EpochInstall witnessed (admin frame, gossip, or journal
+        replay): dedupe against the ledger, apply through report_topology's
+        in-order delivery, and gossip onward so a single admin contact
+        converges the whole membership.  Returns False on a duplicate."""
+        epoch = install.epoch
+        if epoch in self._specs:
+            return False
+        self._specs[epoch] = install
+        if install.peers:
+            self.install_peers(install.peers)
+        node = self.node
+        if node is not None:
+            node.obs.flight.record("epoch_install", None, (epoch, from_id))
+        self.report_topology(install.build_topology())
+        if node is not None and not getattr(node, "replaying", False):
+            self._gossip_install(install, self.GOSSIP_ROUNDS)
+        return True
+
+    def install_peers(self, peers) -> None:
+        """Transport hook: learn addresses for nodes joining in an installed
+        epoch (the TCP host merges them into its peer table)."""
+
+    def _gossip_install(self, install, rounds: int) -> None:
+        node = self.node
+        topology = self.get_topology_for_epoch(install.epoch)
+        if node is None or topology is None:
+            return
+        behind = [to for to in sorted(topology.nodes())
+                  if to != node.id
+                  and not node.topology.epoch_acked_by(install.epoch, to)]
+        for to in behind:
+            node.send(to, install)
+        if not behind or rounds <= 0:
+            return
+        node.scheduler.once(
+            self.GOSSIP_INTERVAL_S,
+            lambda: self._gossip_install(install, rounds - 1))
 
     # ----------------------------------------------------------------- feed --
     def report_topology(self, topology, start_sync: bool = True) -> None:
@@ -172,3 +233,55 @@ class DirectConfigService(AbstractConfigurationService):
             self._fetching.pop(epoch, None)
             return
         self.report_topology(topology)
+
+
+class LedgerConfigService(AbstractConfigurationService):
+    """Live-host service: no shared ledger exists, so epoch gaps are fetched
+    from peers over the transport (TopologyFetchReq against any member of
+    the newest topology we know)."""
+
+    FETCH_TIMEOUT_S = 2.0
+
+    def __init__(self, local_id: int, peers_hook=None):
+        super().__init__(local_id)
+        self._peers_hook = peers_hook
+        self._fetch_rr = 0  # round-robin cursor over candidate sources
+
+    def install_peers(self, peers) -> None:
+        if self._peers_hook is not None:
+            self._peers_hook(peers)
+
+    def fetch_topology(self, epoch: int) -> None:
+        spec = self._specs.get(epoch)
+        if spec is not None:
+            self._fetching.pop(epoch, None)
+            self.report_topology(spec.build_topology())
+            return
+        node = self.node
+        current = self.current_topology()
+        if node is None or current is None:
+            self._fetching.pop(epoch, None)
+            return
+        candidates = [n for n in sorted(current.nodes()) if n != node.id]
+        if not candidates:
+            self._fetching.pop(epoch, None)
+            return
+        from accord_tpu.messages.admin import TopologyFetchOk, TopologyFetchReq
+        from accord_tpu.messages.base import FunctionCallback
+        to = candidates[self._fetch_rr % len(candidates)]
+        self._fetch_rr += 1
+
+        def on_ok(from_id, reply):
+            self._fetching.pop(epoch, None)
+            if isinstance(reply, TopologyFetchOk):
+                # deliver through node.receive so the install is JOURNALED:
+                # an epoch learned only via fetch must still survive a crash
+                node.receive(reply.install, from_id, None)
+
+        def on_fail(from_id, failure):
+            # clear the in-flight flag; the 1 Hz epoch-fetch chain retries
+            self._fetching.pop(epoch, None)
+
+        node.send(to, TopologyFetchReq(epoch),
+                  callback=FunctionCallback(on_ok, on_fail),
+                  timeout_s=self.FETCH_TIMEOUT_S)

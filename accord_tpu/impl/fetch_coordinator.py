@@ -66,13 +66,17 @@ class _Starting:
 
 class FetchCoordinator(Callback):
     def __init__(self, node, ranges: Ranges, sync_point, fetch_ranges,
-                 data_store, timeout_s: float = 10.0):
+                 data_store, timeout_s: Optional[float] = None):
         self.node = node
         self.ranges = ranges
         self.sync_point = sync_point
         self.fetch_ranges = fetch_ranges  # DataStore.FetchRanges callbacks
         self.data_store = data_store
-        self.timeout_s = timeout_s
+        # per-source snapshot-fetch timeout (ACCORD_BOOTSTRAP_TIMEOUT_US via
+        # LocalConfig): a wedged source fails over to the next replica
+        # instead of stalling the whole attempt
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else node.config.bootstrap_fetch_timeout_s)
         self.result = DataStore.FetchResult()
         self.result.abort_hook = self.abort
         self.covered = Ranges.EMPTY
@@ -143,11 +147,18 @@ class FetchCoordinator(Callback):
         return out
 
     def _pick_source(self, shard) -> Optional[int]:
-        for n in shard.nodes:
-            if n != self.node.id and n not in self.inflight \
-                    and (n, shard.range.start) not in self.tried:
-                self.tried.add((n, shard.range.start))
-                return n
+        # draining peers (scale-in, messages/admin.DrainBegin) are last
+        # resort: prefer replicas that will still own the data tomorrow,
+        # but a drainer beats failing the sub-range outright
+        draining = getattr(self.node, "draining_peers", ())
+        candidates = [n for n in shard.nodes
+                      if n != self.node.id and n not in self.inflight
+                      and (n, shard.range.start) not in self.tried]
+        for pool in (True, False):
+            for n in candidates:
+                if (n not in draining) is pool:
+                    self.tried.add((n, shard.range.start))
+                    return n
         return None
 
     def _observe_max_applied(self, max_applied) -> None:

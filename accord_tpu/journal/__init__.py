@@ -63,4 +63,8 @@ def attach_journal_from_env(node):
     node.journal = wal
     if cfg.group_commit:
         node.sink = DurableAckSink(node.sink, wal)
+    # end replay's defer mode: start bootstraps for whatever the journaled
+    # checkpoints left uncovered (with the WAL attached, so fresh progress
+    # is checkpointed too)
+    node.resume_bootstraps()
     return wal

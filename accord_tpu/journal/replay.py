@@ -66,11 +66,18 @@ def replay_node(node, records: List[object], registry=None,
     from accord_tpu.journal.snapshot import _band
     ordered = sorted(records, key=_band)
     prev_journal, node.journal = node.journal, None
+    # replay mode: suppress live side effects of admin records — epoch
+    # installs must not re-gossip, and newly-owned ranges must not start
+    # live bootstraps until resume_bootstraps() reconciles them against
+    # the checkpoint coverage restored further down the same journal
+    node.replaying = True
+    node.defer_bootstrap = True
     try:
         for req in ordered:
             node.receive(req, 0, None)
     finally:
         node.journal = prev_journal
+        node.replaying = False
     txns = len(reconstruct(records))
     duration_us = int((time.monotonic() - t0) * 1e6)
     if registry is not None:

@@ -41,6 +41,12 @@ def _band(msg) -> int:
     from accord_tpu.messages.propagate import Propagate
     from accord_tpu.messages.recover import BeginRecovery
 
+    # admin-plane records (messages/admin.py) pin their own band: epoch
+    # installs / bootstrap checkpoints must replay BEFORE protocol messages
+    # gated on the epochs and watermarks they establish
+    band = getattr(msg, "replay_band", None)
+    if band is not None:
+        return band
     if isinstance(msg, PreAccept):
         return 0
     if isinstance(msg, (Accept, AcceptInvalidate, BeginInvalidation,

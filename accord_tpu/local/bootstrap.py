@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Optional
 
 from accord_tpu.api.spi import DataStore
+from accord_tpu.coordinate.errors import Timeout
 from accord_tpu.coordinate.syncpoint import CoordinateSyncPoint, SyncPoint
 from accord_tpu.primitives.keys import Ranges
 from accord_tpu.primitives.timestamp import TxnKind
@@ -45,12 +46,14 @@ class Bootstrap:
     reference defers the retry policy to Agent.onFailedBootstrap."""
 
     def __init__(self, node, ranges: Ranges, epoch: int,
-                 result: Optional[AsyncResult] = None):
+                 result: Optional[AsyncResult] = None, attempt: int = 1):
         self.node = node
         self.RETRY_DELAY_S = node.config.bootstrap_retry_delay_s
         self.ranges = ranges
         self.epoch = epoch
         self.result = result if result is not None else AsyncResult()
+        self.attempt = attempt
+        self.max_retries = node.config.bootstrap_max_retries
         self.sp: Optional[SyncPoint] = None
         self.covered = Ranges.EMPTY
         self.fetch_result: Optional[DataStore.FetchResult] = None
@@ -58,6 +61,8 @@ class Bootstrap:
         self.done = False
 
     def start(self) -> "Bootstrap":
+        self.node.obs.flight.record("bootstrap_begin", None,
+                                    (self.epoch, self.attempt))
         CoordinateSyncPoint.coordinate(
             self.node, TxnKind.EXCLUSIVE_SYNC_POINT, self.ranges,
             await_applied=False).add_callback(self._on_fence)
@@ -66,10 +71,23 @@ class Bootstrap:
     def _retry(self) -> None:
         if self.done:
             return
+        if self.attempt >= self.max_retries:
+            # bounded: exhausting the budget fails the epoch-level result
+            # (the caller's start_sync stays honest — no sync-complete
+            # broadcast for data we never acquired)
+            self.node.obs.flight.record(
+                "bootstrap_done", None, (self.epoch, self.attempt, "failed"))
+            self.result.try_failure(Timeout(
+                f"bootstrap of {self.ranges.subtract(self.covered)!r} for "
+                f"epoch {self.epoch} failed after {self.attempt} attempts"))
+            return
+        delay = min(self.RETRY_DELAY_S * (2 ** (self.attempt - 1)),
+                    self.node.config.bootstrap_retry_delay_cap_s)
         self.node.scheduler.once(
-            self.RETRY_DELAY_S,
+            delay,
             lambda: Bootstrap(self.node, self.ranges.subtract(self.covered),
-                              self.epoch, self.result).start()
+                              self.epoch, self.result,
+                              attempt=self.attempt + 1).start()
             if not self.result.is_done else None)
 
     def abort(self, ranges: Ranges) -> None:
@@ -162,5 +180,26 @@ class Bootstrap:
             # deps below the fence are now satisfied by the snapshot:
             # re-evaluate everything blocked on them
             store.execute(PreLoadContext.empty(), C.re_evaluate_waiting)
+        self._journal_checkpoint(
+            finalize, max_conflict if max_conflict > TS_NONE else None)
         if complete:
+            self.node.obs.flight.record(
+                "bootstrap_done", None, (self.epoch, self.attempt, "ok"))
             self.result.try_success(finalize)
+
+    def _journal_checkpoint(self, finalize: Ranges, max_conflict) -> None:
+        """WAL progress record for the finalized coverage: a crash after
+        this point resumes from here (BootstrapCheckpoint replay reinstalls
+        the snapshot + watermarks) instead of re-fetching the ranges."""
+        node = self.node
+        if node.journal is None or getattr(node, "replaying", False):
+            return
+        from accord_tpu.messages.admin import BootstrapCheckpoint
+        snapshot = node.data_store.snapshot_ranges(finalize) \
+            if hasattr(node.data_store, "snapshot_ranges") else {}
+        node.journal.record(node.id, BootstrapCheckpoint(
+            self.epoch, self.sp.txn_id, finalize, snapshot,
+            max_conflict=max_conflict, max_applied=self.max_applied))
+        node.obs.flight.record(
+            "bootstrap_checkpoint", None,
+            (self.epoch, self.attempt, len(finalize)))

@@ -152,6 +152,24 @@ class Node:
         # (needing a fresh fence once it completes)
         self._stale_bootstrapping: Ranges = Ranges.EMPTY
         self._stale_requeue: Ranges = Ranges.EMPTY
+        # -- live elasticity (messages/admin.py, impl/config_service.py) --
+        # the attached configuration service (set by attach_node), the
+        # replay/defer flags crash-restart uses to suspend live side effects,
+        # and the drain state the scale-in protocol maintains
+        self.config_service = None
+        self.replaying = False
+        # while True, on_topology_update records newly-owned ranges instead
+        # of starting live bootstraps (journal replay / restart feed);
+        # resume_bootstraps() then starts only what checkpoints left uncovered
+        self.defer_bootstrap = False
+        self._deferred_bootstrap: Dict[int, Ranges] = {}
+        # epoch -> coverage restored from journaled BootstrapCheckpoint
+        # records; epochs whose BootstrapDone marker replayed
+        self._ckpt_bootstrapped: Dict[int, Ranges] = {}
+        self._bootstrap_complete: set = set()
+        self.draining = False   # this node is fenced against new client work
+        self.drained = False    # drain handoff finished; safe to retire
+        self.draining_peers: set = set()  # peers to deprioritize as sources
 
     # ------------------------------------------------------------ lifecycle --
     def on_topology_update(self, topology: Topology, start_sync: bool = True
@@ -171,8 +189,19 @@ class Node:
         epoch = topology.epoch
 
         def synced(_v=None, _f=None):
-            self._broadcast_sync_complete(epoch, topology)
+            # honest start_sync: a FAILED bootstrap (bounded retries
+            # exhausted) must not report the epoch synced — peers would
+            # route reads at data this node never acquired
+            if _f is None:
+                self._broadcast_sync_complete(epoch, topology)
 
+        if self.defer_bootstrap and not first and start_sync:
+            # journal replay / restart feed: record what this epoch added
+            # (stores are already re-ranged above) and let
+            # resume_bootstraps() reconcile it against checkpointed
+            # coverage once the journal has finished replaying
+            self._deferred_bootstrap[epoch] = added
+            return added
         if added.is_empty or first or not start_sync:
             # nothing to copy (or the genesis epoch: there is no data yet)
             for store in self.command_stores.intersecting(added):
@@ -182,6 +211,9 @@ class Node:
         else:
             from accord_tpu.local.bootstrap import Bootstrap
             attempt = Bootstrap(self, added, epoch)
+            attempt.result.add_callback(
+                lambda v, f, e=epoch, r=added:
+                self._journal_bootstrap_done(e, r) if f is None else None)
             attempt.result.add_callback(synced)
             attempt.start()
         return added
@@ -192,6 +224,44 @@ class Node:
         for to in sorted(topology.nodes()):
             if to != self.id:
                 self.send(to, EpochSyncComplete(epoch))
+
+    def _journal_bootstrap_done(self, epoch: int, ranges: Ranges) -> None:
+        self._bootstrap_complete.add(epoch)
+        if self.journal is None or self.replaying:
+            return
+        from accord_tpu.messages.admin import BootstrapDone
+        self.journal.record(self.id, BootstrapDone(epoch, ranges))
+
+    def resume_bootstraps(self) -> None:
+        """End defer mode after a journal replay / restart feed: reconcile
+        each deferred epoch's newly-owned ranges against the coverage its
+        journaled BootstrapCheckpoint records restored, and bootstrap ONLY
+        the remainder — a crash mid-bootstrap resumes from the checkpointed
+        watermark instead of re-fetching completed ranges."""
+        self.defer_bootstrap = False
+        deferred, self._deferred_bootstrap = self._deferred_bootstrap, {}
+        for epoch in sorted(deferred):
+            added = deferred[epoch]
+            restored = self._ckpt_bootstrapped.pop(epoch, Ranges.EMPTY)
+            remaining = added.subtract(restored)
+            topology = self.topology.for_epoch(epoch)
+            if remaining.is_empty or epoch in self._bootstrap_complete:
+                # every owned range is covered (checkpoints, or nothing was
+                # added): the epoch is synced as far as this node goes
+                for store in self.command_stores.intersecting(remaining):
+                    store.mark_safe_to_read(remaining)
+                self._broadcast_sync_complete(epoch, topology)
+                continue
+            from accord_tpu.local.bootstrap import Bootstrap
+            attempt = Bootstrap(self, remaining, epoch)
+
+            def finished(_v, _f, e=epoch, t=topology, r=added):
+                if _f is None:
+                    self._journal_bootstrap_done(e, r)
+                    self._broadcast_sync_complete(e, t)
+
+            attempt.result.add_callback(finished)
+            attempt.start()
 
     def mark_stale_and_bootstrap(self, ranges: Ranges) -> None:
         """Re-acquire `ranges` wholesale after local per-txn catch-up proved

@@ -72,6 +72,17 @@ class MessageType(enum.Enum):
     AUDIT_DIGEST_RSP = ("AUDIT_DIGEST_RSP", False)
     AUDIT_ENTRIES_REQ = ("AUDIT_ENTRIES_REQ", False)
     AUDIT_ENTRIES_RSP = ("AUDIT_ENTRIES_RSP", False)
+    # live-elasticity admin plane (messages/admin.py): epoch installs gossip
+    # node-to-node and must be journaled before the admin ack; drain and
+    # bootstrap-progress records are WAL lifecycle markers that crash-restart
+    # replays to resume (not restart) an interrupted reshard
+    EPOCH_INSTALL_MSG = ("EPOCH_INSTALL_MSG", True)
+    TOPOLOGY_FETCH_REQ = ("TOPOLOGY_FETCH_REQ", False)
+    TOPOLOGY_FETCH_RSP = ("TOPOLOGY_FETCH_RSP", False)
+    DRAIN_BEGIN_MSG = ("DRAIN_BEGIN_MSG", True)
+    DRAIN_DONE_MSG = ("DRAIN_DONE_MSG", True)
+    BOOTSTRAP_CHECKPOINT_MSG = ("BOOTSTRAP_CHECKPOINT_MSG", True)
+    BOOTSTRAP_DONE_MSG = ("BOOTSTRAP_DONE_MSG", True)
     SIMPLE_RSP = ("SIMPLE_RSP", False)
     FAILURE_RSP = ("FAILURE_RSP", False)
     # local-only (never cross the network; applied via Node.local_request)

@@ -95,6 +95,21 @@ EVENT_KINDS = {
                         "threshold (obs/cpuprof.LoopHealth, wired by "
                         "host/tcp.py and host/maelstrom.py; edge-"
                         "triggered); data=(depth,)",
+    "epoch_install": "admin-plane epoch install accepted/journaled "
+                     "(impl/config_service.py); data=(epoch, from_id)",
+    "bootstrap_begin": "bootstrap attempt fenced + fetch started "
+                       "(local/bootstrap.py); data=(epoch, attempt)",
+    "bootstrap_checkpoint": "bootstrap progress checkpoint journaled — "
+                            "crash resumes from here instead of "
+                            "re-fetching (local/bootstrap.py); "
+                            "data=(epoch, attempt, n_ranges)",
+    "bootstrap_done": "bootstrap attempt chain settled ok/failed "
+                      "(local/bootstrap.py); data=(epoch, attempt, "
+                      "outcome)",
+    "drain_begin": "scale-in drain fence raised on/about a retiring node "
+                   "(messages/admin.py); data=(node_id, from_id)",
+    "drain_done": "retiring node durably handed off + retired "
+                  "(messages/admin.py); data=(node_id, from_id)",
 }
 
 

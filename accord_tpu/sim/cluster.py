@@ -206,11 +206,13 @@ class SimCluster:
         self.config_services[nid] = service
         if nid in self.dead:
             # restart: feed the full epoch history (replayed messages gate
-            # on their txn's epoch) WITHOUT peer bootstraps — the journal
-            # replay that follows is this node's data source
+            # on their txn's epoch) in DEFER mode — bootstraps are queued,
+            # not started, and restart_node's resume_bootstraps() reconciles
+            # them against the checkpoint coverage the journal replay
+            # restores (re-fetching only what the checkpoints left missing)
+            node.defer_bootstrap = True
             for epoch in sorted(self.topology_ledger):
-                service.report_topology(self.topology_ledger[epoch],
-                                        start_sync=False)
+                service.report_topology(self.topology_ledger[epoch])
         else:
             service.report_topology(self.topology)
         return node
@@ -329,6 +331,9 @@ class SimCluster:
         from accord_tpu.journal.replay import replay_node
         replay_node(node, records, registry=node.obs.registry,
                     flight=node.obs.flight)
+        # end replay's defer mode: start live bootstraps only for whatever
+        # the journaled checkpoints left uncovered
+        node.resume_bootstraps()
         if self._durability_cycle_s is not None:
             from accord_tpu.coordinate.durability import \
                 CoordinateDurabilityScheduling

@@ -164,6 +164,13 @@ class TopologyManager:
         state = self._epochs.get(epoch)
         return state is not None and state.sync_complete
 
+    def epoch_acked_by(self, epoch: int, node: int) -> bool:
+        """Has `node` reported sync-complete for `epoch`?  The epoch-install
+        gossip uses this to stop resending to peers that have demonstrably
+        caught up (a sync ack implies the peer knows the topology)."""
+        state = self._epochs.get(epoch)
+        return state is not None and node in state.synced_nodes
+
     def sync_complete_for(self, epoch: int, select) -> bool:
         """Epoch-sync test at range granularity: true when the selection's
         ranges all belong to quorum-synced shards of `epoch`, even if the

@@ -19,8 +19,10 @@ per phase, open- vs closed-loop) is built by `obs/report.slo_report`.
 """
 
 from accord_tpu.workload.arrival import make_offsets_us
-from accord_tpu.workload.openloop import run_open_loop_sim, run_open_loop_tcp
+from accord_tpu.workload.openloop import (run_open_loop_sim,
+                                          run_open_loop_tcp,
+                                          run_reshard_tcp)
 from accord_tpu.workload.profiles import PROFILES, build_txn, make_profile
 
 __all__ = ["PROFILES", "build_txn", "make_profile", "make_offsets_us",
-           "run_open_loop_sim", "run_open_loop_tcp"]
+           "run_open_loop_sim", "run_open_loop_tcp", "run_reshard_tcp"]

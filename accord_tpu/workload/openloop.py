@@ -282,3 +282,351 @@ def run_open_loop_tcp(profile: str = "zipfian", ops: int = 300,
                           _collect(records, rate_per_s, sched, summary,
                                    t0_us),
                           summary, sched)
+
+
+# --------------------------------------------------------- reshard lane ----
+
+def _window_stats(recs: List[OpRecord]) -> dict:
+    """Ack rate + open-loop quantiles of one reshard window's records."""
+    lat = sorted(max(0, r.end_us - r.intended_us) for r in recs
+                 if r.outcome == "ack")
+
+    def q(p: float) -> Optional[int]:
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else None
+    n = len(recs)
+    return {"count": n, "acked": len(lat),
+            "shed": sum(1 for r in recs if r.outcome == "shed"),
+            "failed": sum(1 for r in recs if r.outcome == "fail"),
+            "ack_rate": round(len(lat) / n, 4) if n else None,
+            "open_p50_us": q(0.50), "open_p99_us": q(0.99)}
+
+
+def _reshard_report(records: List[OpRecord], t0_us: int, begin_us: int,
+                    end_us: int, bucket_us: int = 1_000_000) -> dict:
+    """Fold the ledger around the reshard window: per-window stats,
+    1s-bucket availability dip, and time-to-SLO-recovery measured from the
+    moment the reshard began (bucket ack rate back >= 95% AND bucket open
+    p99 back under max(2x the before-window p99, 100ms))."""
+    windows = {
+        "before": _window_stats([r for r in records
+                                 if r.intended_us < begin_us]),
+        "during": _window_stats([r for r in records
+                                 if begin_us <= r.intended_us < end_us]),
+        "after": _window_stats([r for r in records
+                                if r.intended_us >= end_us]),
+    }
+    buckets: Dict[int, list] = {}
+    for r in records:
+        b = (r.intended_us - t0_us) // bucket_us
+        tot_ack = buckets.setdefault(b, [0, 0, []])
+        tot_ack[0] += 1
+        if r.outcome == "ack":
+            tot_ack[1] += 1
+            tot_ack[2].append(max(0, r.end_us - r.intended_us))
+    begin_b = (begin_us - t0_us) // bucket_us
+    base_p99 = windows["before"]["open_p99_us"] or 0
+    thresh_us = max(2 * base_p99, 100_000)
+    dip_rates = [ack / tot for b, (tot, ack, _l) in sorted(buckets.items())
+                 if b >= begin_b and tot > 0]
+    before_rate = windows["before"]["ack_rate"] or 0.0
+    recovery_s = None
+    for b in sorted(buckets):
+        if b < begin_b:
+            continue
+        tot, ack, lats = buckets[b]
+        if tot == 0:
+            continue
+        lats.sort()
+        p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))] if lats else 0
+        if ack / tot >= 0.95 and p99 <= thresh_us:
+            recovery_s = round(max(0.0, (t0_us + b * bucket_us - begin_us)
+                                   / 1e6), 3)
+            break
+    min_rate = round(min(dip_rates), 4) if dip_rates else None
+    return {
+        "windows": windows,
+        "availability": {
+            "before_ack_rate": before_rate,
+            "min_bucket_ack_rate": min_rate,
+            "dip_pct": round(max(0.0, (before_rate - (min_rate or 0.0)))
+                             * 100.0, 2) if min_rate is not None else None,
+            "bucket_s": bucket_us / 1e6,
+        },
+        "time_to_slo_recovery_s": recovery_s,
+    }
+
+
+def run_reshard_tcp(profile: str = "zipfian", ops: int = 2400,
+                    rate_per_s: float = 80.0, schedule: str = "poisson",
+                    seed: int = 13, nodes: int = 3, keys: int = 48,
+                    n_shards: int = 4, reshard_at_frac: float = 0.33,
+                    want_phases: bool = True,
+                    settle_timeout_s: float = 90.0,
+                    drain_retiring: bool = True) -> OpenLoopResult:
+    """The slo-reshard lane: open-loop zipfian over the live TCP cluster
+    with a FULL membership change mid-window — a fresh journal-backed node
+    joins and bootstraps under load (admin epoch install, one contact,
+    gossip convergence), the client re-learns routing from a topology
+    frame, and the founding node drains (coordination fenced, in-flight
+    handed off, durability watermark awaited) and is retired.
+
+    The ledger is folded around the reshard window into before/during/
+    after ack-rate + open-loop p99, a 1s-bucket availability dip, and
+    time-to-SLO-recovery; afterwards every acked append is re-read from
+    the surviving membership (zero-lost-acks) and the per-node audit
+    views are collected (cross-replica digest agreement at quiesce).
+
+    Admin traffic and submit replies share the client's single reply
+    inbox: the paced loop stashes non-submit frames into a dict the
+    driver thread polls, and the driver only ever sends (socket writes
+    are lock-serialized in TcpClusterClient._send)."""
+    import threading
+
+    from accord_tpu.host.maelstrom import TOKEN_SPAN
+    from accord_tpu.host.tcp import TcpClusterClient
+
+    rng = RandomSource(seed)
+    prof = make_profile(profile, keys=keys, seed=rng.next_long())
+    offsets = make_offsets_us(schedule, rate_per_s, ops,
+                              seed=rng.next_long())
+    ops_list = [prof.next_op() for _ in range(ops)]
+    assert all(op.ranges is None for op in ops_list), \
+        "range ops are sim-only (no wire encoding on the submit frame)"
+    span_us = offsets[-1] if offsets else 0
+
+    client = TcpClusterClient(n_nodes=nodes, n_shards=n_shards)
+    admin_replies: Dict[str, dict] = {}
+    events: List[list] = []  # [label, wall_us] markers from the driver
+    driver_err: List[BaseException] = []
+    retiring = 1
+
+    def now_us() -> int:
+        return int(time.time() * 1e6)
+
+    def mark(label: str) -> None:
+        events.append([label, now_us()])
+
+    def admin_wait(req: str, timeout_s: float) -> dict:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            body = admin_replies.pop(req, None)
+            if body is not None:
+                return body
+            time.sleep(0.01)
+        raise TimeoutError(f"no admin reply for {req}")
+
+    def node_at_epoch(nid: int, epoch: int, deadline: float) -> dict:
+        k = 0
+        while time.monotonic() < deadline:
+            k += 1
+            req = f"rs-topo-{nid}-{k}"
+            try:
+                client._send(nid, {"type": "topology", "req": req})
+                spec = admin_wait(req, 2.0).get("topology") or {}
+            except (TimeoutError, OSError):
+                continue
+            if spec.get("epoch", 0) >= epoch:
+                return spec
+            time.sleep(0.1)
+        raise TimeoutError(f"node {nid} never reached epoch {epoch}")
+
+    def reshard_driver(t0_us: int) -> None:
+        try:
+            target_us = t0_us + int(reshard_at_frac * span_us)
+            while now_us() < target_us:
+                time.sleep(0.01)
+            mark("reshard_begin")
+            joined = client.add_node()
+            mark("node_added")
+            # epoch 2 replaces the retiring founder with the joiner:
+            # replicas rotated over the surviving membership, same even
+            # token split every host transport uses
+            ids2 = sorted([i for i in range(1, nodes + 1)
+                           if i != retiring] + [joined])
+            rf = min(3, len(ids2))
+            width = TOKEN_SPAN // n_shards
+            shards = [[i * width,
+                       TOKEN_SPAN if i == n_shards - 1 else (i + 1) * width,
+                       [ids2[(i + j) % len(ids2)] for j in range(rf)]]
+                      for i in range(n_shards)]
+            req = "rs-epoch-2"
+            client._send(retiring, {
+                "type": "epoch", "req": req,
+                "topology": {"epoch": 2, "shards": shards,
+                             "peers": [[joined] +
+                                       list(client.peers[joined])]}})
+            admin_wait(req, 30.0)
+            mark("epoch_acked")
+            deadline = time.monotonic() + 60.0
+            spec = None
+            for nid in ids2 + [retiring]:
+                spec = node_at_epoch(nid, 2, deadline)
+            mark("epoch_converged")
+            # routing refresh: the paced loop routes by owner_of, which
+            # reads this spec — without it the client submits against the
+            # pre-reshard ownership map forever
+            client.topology_spec = spec
+            mark("routing_refreshed")
+            if drain_retiring:
+                req = f"rs-drain-{retiring}"
+                client._send(retiring, {"type": "drain", "req": req,
+                                        "timeout_s": 45.0})
+                body = admin_wait(req, 60.0)
+                mark("drain_ok" if body.get("durable") else "drain_undurable")
+                client.kill_node(retiring)
+                mark("retired")
+            mark("reshard_end")
+        except BaseException as e:  # noqa: BLE001
+            driver_err.append(e)
+            mark("reshard_failed")
+
+    summary = None
+    audit_views = {}
+    lost: List[tuple] = []
+    acked_appends = 0
+    verified_keys = 0
+    try:
+        t0_us = now_us()
+        records = [OpRecord(i, t0_us + off) for i, off in enumerate(offsets)]
+
+        def handle(frame) -> bool:
+            body = frame.get("body", {})
+            typ = body.get("type")
+            if typ != "submit_reply":
+                if typ in ("epoch_ok", "topology_reply", "drain_ok"):
+                    admin_replies[body.get("req")] = body
+                return False
+            req = body.get("req")
+            if not isinstance(req, int):
+                return False
+            rec = records[req]
+            rec.end_us = now_us()
+            if body.get("ok"):
+                rec.outcome = "ack"
+                if body.get("phases"):
+                    rec.phase_firsts = [(ph, at) for ph, at
+                                        in body["phases"]]
+            elif body.get("shed"):
+                rec.outcome = "shed"
+            else:
+                rec.outcome = "fail"
+            return True
+
+        driver = threading.Thread(target=reshard_driver, args=(t0_us,),
+                                  daemon=True)
+        driver.start()
+
+        sent = pending = 0
+        while sent < ops:
+            due_us = records[sent].intended_us
+            now = now_us()
+            if now < due_us:
+                frame = client.recv(min(0.05, (due_us - now) / 1e6))
+                if frame is not None and handle(frame):
+                    pending -= 1
+                continue
+            op = ops_list[sent]
+            tok0 = next(iter(op.reads), None)
+            if tok0 is None and op.appends:
+                tok0 = next(iter(op.appends))
+            records[sent].submit_us = now_us()
+            try:
+                client.submit(client.owner_of(tok0 or 0), op.reads,
+                              op.appends, sent, ephemeral=op.ephemeral,
+                              want_phases=want_phases)
+            except OSError:
+                records[sent].end_us = now_us()
+                records[sent].outcome = "fail"
+                sent += 1
+                continue
+            sent += 1
+            pending += 1
+        deadline = time.monotonic() + settle_timeout_s
+        while pending > 0 and time.monotonic() < deadline:
+            frame = client.recv(1.0)
+            if frame is not None and handle(frame):
+                pending -= 1
+        # keep pumping the shared inbox while the driver finishes — its
+        # admin replies (epoch_ok / topology_reply / drain_ok) only reach
+        # the stash through handle()
+        deadline = time.monotonic() + 120.0
+        while driver.is_alive() and time.monotonic() < deadline:
+            frame = client.recv(0.2)
+            if frame is not None and handle(frame):
+                pending -= 1
+        driver.join(timeout=5.0)
+
+        # zero-lost-acks: every acked append must be readable from the
+        # surviving membership (final reads through the refreshed routing)
+        acked_by_key: Dict[int, List[int]] = {}
+        for i, rec in enumerate(records):
+            if rec.outcome == "ack":
+                for tok, val in ops_list[i].appends.items():
+                    acked_by_key.setdefault(tok, []).append(val)
+                    acked_appends += 1
+        final_reads: Dict[int, list] = {}
+        outstanding = set()
+        for tok in acked_by_key:
+            req = f"fr-{tok}"
+            client.submit(client.owner_of(tok), [tok], {}, req)
+            outstanding.add(req)
+        deadline = time.monotonic() + 60.0
+        while outstanding and time.monotonic() < deadline:
+            frame = client.recv(1.0)
+            if frame is None:
+                continue
+            body = frame.get("body", {})
+            req = body.get("req")
+            if body.get("type") == "submit_reply" and req in outstanding:
+                outstanding.discard(req)
+                if body.get("ok"):
+                    for tok, vals in (body.get("reads") or {}).items():
+                        final_reads[int(tok)] = vals
+        for tok, vals in sorted(acked_by_key.items()):
+            got = final_reads.get(tok)
+            if got is None:
+                lost.append((tok, "unread", len(vals)))
+                continue
+            verified_keys += 1
+            for val in vals:
+                if val not in got:
+                    lost.append((tok, "missing", val))
+
+        # audit agreement at quiesce: the cross-replica digest rounds are
+        # watermark-negotiated, so any recorded divergence is real
+        live = sorted(n for n in range(1, len(client.procs) + 1)
+                      if not (drain_retiring and n == retiring))
+        for nid in live:
+            view = client.fetch_audit(nid, timeout_s=10.0)
+            if view:
+                audit_views[nid] = len(view.get("divergences") or [])
+        from accord_tpu.obs.report import merge_node_snapshots
+        snaps = [client.fetch_metrics(n, timeout_s=10.0) for n in live]
+        merged = merge_node_snapshots([s for s in snaps if s])
+        summary = merged["summary"] if merged["nodes"] else None
+    finally:
+        client.close()
+
+    if driver_err:
+        raise RuntimeError(f"reshard driver failed: {driver_err[0]!r}; "
+                           f"events={events}") from driver_err[0]
+    marks = dict((label, at) for label, at in events)
+    begin_us = marks.get("reshard_begin", t0_us)
+    end_us = marks.get("reshard_end", begin_us)
+    sched = {"kind": schedule, "rate_per_s": rate_per_s, "ops": ops,
+             "seed": seed, "host": "tcp-reshard"}
+    report = _collect(records, rate_per_s, sched, summary, t0_us)
+    reshard = _reshard_report(records, t0_us, begin_us, end_us)
+    reshard["events"] = [[label, round((at - t0_us) / 1e6, 3)]
+                         for label, at in events]
+    reshard["lost_acks"] = len(lost)
+    reshard["lost_detail"] = lost[:16]
+    reshard["acked_appends"] = acked_appends
+    reshard["verified_keys"] = verified_keys
+    reshard["audit"] = {"divergences_by_node": audit_views,
+                        "agree": all(v == 0 for v in audit_views.values())
+                        and bool(audit_views)}
+    reshard["joined_node"] = nodes + 1
+    reshard["retired_node"] = retiring if drain_retiring else None
+    report["reshard"] = reshard
+    return OpenLoopResult(records, report, summary, sched)

@@ -1621,6 +1621,50 @@ def bench_slo_tcp(config: str, profile: str, ops: int = 400,
     })
 
 
+def bench_slo_reshard(seed: int = 13):
+    """Reshard-survival SLO lane (live elasticity): the open-loop zipfian
+    TCP lane with a FULL membership change mid-window — a journal-backed
+    node joins and bootstraps under load, the client refreshes routing
+    from a topology frame, and a founding node drains and retires.  The
+    row records the availability dip, before/during/after open-loop p99,
+    time-to-SLO-recovery, and the zero-lost-acks + audit-agreement
+    verdicts; `--guard` gates the tails like every other SLO lane and
+    `--guard --dry-run` enforces the reshard row schema."""
+    from accord_tpu.workload.openloop import run_reshard_tcp
+
+    os.environ["ACCORD_PIPELINE"] = "1"
+    os.environ.setdefault("ACCORD_PIPELINE_MAX_BATCH", "8")
+    os.environ.setdefault("ACCORD_PIPELINE_MAX_WAIT_US", "2000")
+    ops = int(os.environ.get("ACCORD_SLO_OPS", "2400"))
+    rate = float(os.environ.get("ACCORD_SLO_RATE", "80"))
+    frac = float(os.environ.get("ACCORD_RESHARD_AT", "0.33"))
+    run = run_reshard_tcp(ops=ops, rate_per_s=rate, reshard_at_frac=frac,
+                          seed=seed)
+    rep = run.report
+    counts = rep["counts"]
+    assert counts["acked"] > 0.5 * ops, counts
+    rs = rep["reshard"]
+    assert rs["lost_acks"] == 0, rs["lost_detail"]
+    assert rs["audit"]["agree"], rs["audit"]
+    emit({
+        "metric": "slo_reshard_txn_per_sec",
+        "value": rep["achieved_per_s"],
+        "unit": "txn/s",
+        "workload": "open-loop zipfian via TCP pipeline host with a "
+                    "mid-window membership reshard (join+bootstrap, "
+                    "epoch gossip, drain+retire)",
+        "ops": ops,
+        "acked": counts["acked"],
+        "shed": counts["shed"],
+        "offered_per_s": rep["offered_per_s"],
+        "open_p99_ms": round(rep["open_loop"]["p99_us"] / 1e3, 1),
+        "availability_dip_pct": rs["availability"]["dip_pct"],
+        "time_to_slo_recovery_s": rs["time_to_slo_recovery_s"],
+        "lost_acks": rs["lost_acks"],
+        "slo": rep,
+    })
+
+
 # ---------------------------------------------------------------- guard ----
 
 GUARD_PCT = 15.0  # per-kernel (and headline) regression threshold, percent
@@ -1779,6 +1823,22 @@ def _validate_slo_schema(slo: dict, where: str) -> None:
     for k in ("offered_per_s", "achieved_per_s", "counts", "shed_rate",
               "schedule"):
         assert k in slo, f"{where}: missing {k}"
+    if where.startswith("slo-reshard") or "reshard" in slo:
+        # reshard-survival row contract: the elasticity verdicts the lane
+        # exists to record must be present and clean — a recorded baseline
+        # with lost acks or no measured recovery must fail CI, not gate
+        rs = slo.get("reshard")
+        assert isinstance(rs, dict), f"{where}: missing reshard section"
+        assert rs.get("lost_acks") == 0, \
+            f"{where}: reshard row with lost acks: {rs.get('lost_acks')}"
+        assert isinstance(rs.get("time_to_slo_recovery_s"), (int, float)), \
+            f"{where}: reshard row without a measured SLO recovery time"
+        for k in ("windows", "availability", "events", "audit"):
+            assert k in rs, f"{where}: reshard missing {k}"
+        for w in ("before", "during", "after"):
+            assert w in rs["windows"], f"{where}: reshard window {w}"
+        assert rs["audit"].get("agree") is True, \
+            f"{where}: reshard row with audit divergence"
 
 
 def _guard_baseline(result: dict):
@@ -1981,7 +2041,8 @@ def main():
                              "pipeline", "scalar", "journal",
                              "slo-zipf", "slo-range", "slo-tpcc",
                              "slo-ephemeral", "slo-tcp", "ephemeral",
-                             "slo-journal", "audit", "multicore"])
+                             "slo-journal", "slo-reshard", "audit",
+                             "multicore"])
     ap.add_argument("--guard", action="store_true",
                     help="after the run, diff the row (headline + per-"
                          "kernel profile p50s) against the last clean "
@@ -2024,7 +2085,8 @@ def main():
     if ns.config not in ("maelstrom", "maelstrom-rw", "tcp", "pipeline",
                          "scalar", "journal", "slo-zipf", "slo-range",
                          "slo-tpcc", "slo-ephemeral", "slo-tcp",
-                         "ephemeral", "slo-journal", "audit", "multicore"):
+                         "ephemeral", "slo-journal", "slo-reshard",
+                         "audit", "multicore"):
         # device-using configs probe the (possibly dead-tunneled) backend
         # first; host-only configs never touch the chip
         from accord_tpu.utils.backend import resolve_platform
@@ -2065,6 +2127,8 @@ def main():
             "ACCORD_JOURNAL",
             tempfile.mkdtemp(prefix="accord-slo-journal-"))
         bench_slo_tcp("slo-journal", "zipfian", ops=400, rate_per_s=80.0)
+    elif ns.config == "slo-reshard":
+        bench_slo_reshard()
     elif ns.config == "audit":
         bench_audit()
     elif ns.config == "multicore":

@@ -304,3 +304,52 @@ def test_issue10_tcp_cpu_row_improved_vs_pr9_baseline():
     base = row["baselines"][0]
     assert base["slo_open_p99_us"] > 0
     assert "admission" in base["slo_phases"]
+
+
+# ------------------------------- reshard-survival lane (ISSUE 12) --
+
+def test_reshard_guard_dry_run_validates_reshard_row_schema():
+    """The recorded slo-reshard row must stay guard-parseable AND carry
+    the elasticity verdicts the lane exists for: zero lost acks, a
+    measured time-to-SLO-recovery, per-window stats around the reshard,
+    and cross-replica audit agreement at quiesce."""
+    proc = _run(["--config", "slo-reshard", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "slo-reshard_guard" and row["dry_run"] is True
+    assert row["baselines"], "no slo-reshard baseline in BENCH_HISTORY.json"
+    assert row["baselines"][0]["slo_open_p99_us"] > 0
+    hist = json.load(open(os.path.join(
+        REPO, os.environ.get("ACCORD_BENCH_HISTORY",
+                             "BENCH_HISTORY.json"))))
+    rs = hist["slo-reshard"]["host"]["slo"]["reshard"]
+    assert rs["lost_acks"] == 0
+    assert isinstance(rs["time_to_slo_recovery_s"], (int, float))
+    assert rs["audit"]["agree"] is True
+    assert set(rs["windows"]) == {"before", "during", "after"}
+    labels = [label for label, _at in rs["events"]]
+    for must in ("reshard_begin", "node_added", "epoch_converged",
+                 "routing_refreshed", "drain_ok", "retired"):
+        assert must in labels, (must, labels)
+
+
+def test_reshard_guard_dry_run_rejects_lost_ack_rows(tmp_path):
+    """A reshard row recording lost acks (or no measured recovery) must
+    fail the dry run — a broken elasticity baseline must fail CI, not
+    silently keep gating tails."""
+    good = json.load(open(os.path.join(REPO, "BENCH_HISTORY.json")))
+    lane = json.loads(json.dumps(good["slo-reshard"]))  # deep copy
+    lane["host"]["slo"]["reshard"]["lost_acks"] = 1
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps({"slo-reshard": lane}))
+    proc = _run(["--config", "slo-reshard", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "lost acks" in (proc.stderr + proc.stdout)
+    lane = json.loads(json.dumps(good["slo-reshard"]))
+    lane["host"]["slo"]["reshard"]["time_to_slo_recovery_s"] = None
+    hist.write_text(json.dumps({"slo-reshard": lane}))
+    proc = _run(["--config", "slo-reshard", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "recovery" in (proc.stderr + proc.stdout)

@@ -1,0 +1,368 @@
+"""Live elasticity (ISSUE 12): online epoch change, journal-backed
+bootstrap under failure, drain/retire, and the reshard-survival nemesis
+arms.
+
+Deterministic properties run in the sim (virtual time: fetch timeouts,
+retry backoff, and crash points are exact); the black-box survival arms
+run against the real multi-process TCP cluster and are marked `slow`.
+"""
+
+import time
+
+import pytest
+
+from accord_tpu.impl.list_store import ListQuery, ListRead, ListUpdate
+from accord_tpu.messages.admin import EpochInstall
+from accord_tpu.primitives.keys import Key, Keys, Range
+from accord_tpu.primitives.timestamp import TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.sim.cluster import SimCluster
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+
+
+def _write(cluster, origin: int, token: int, value: int) -> list:
+    keys = Keys.of(token)
+    txn = Txn(TxnKind.WRITE, keys, read=ListRead(keys), query=ListQuery(),
+              update=ListUpdate({Key(token): value}))
+    out = []
+    cluster.nodes[origin].coordinate(txn).add_callback(
+        lambda v, f: out.append(f))
+    return out
+
+
+def _install(cluster, contact: int, topology: Topology) -> None:
+    """Admin-path install: ledger recorded for restart rebuilds, then the
+    EpochInstall delivered to ONE node — gossip must do the rest."""
+    cluster.topology_ledger[topology.epoch] = topology
+    cluster.topology = topology
+    cluster.nodes[contact].receive(EpochInstall.from_topology(topology),
+                                   0, None)
+
+
+def _flight(node, kind: str) -> list:
+    return [e for e in node.obs.flight.tail(500) if e[2] == kind]
+
+
+# --------------------------------------------- bounded retries + backoff ----
+
+def test_bootstrap_fetch_timeout_bounded_retries_with_backoff():
+    """An unreachable snapshot source must not wedge a joiner forever:
+    each fetch times out, the attempt retries under exponential backoff,
+    and the budget is BOUNDED — exhaustion emits the `failed` flight
+    event and the epoch-level result fails (no sync-complete broadcast
+    for data never acquired)."""
+    from accord_tpu.messages.epoch import FetchSnapshot
+
+    c = SimCluster(n_nodes=3, seed=5, n_shards=2, rf=3)
+    c.process_all()
+    failures = _write(c, 1, 600, 7)
+    c.process_all()
+    assert failures == [None]
+
+    node = c._build_node(4)
+    c.process_all()
+    node.config.bootstrap_fetch_timeout_s = 2.0
+    node.config.bootstrap_max_retries = 2
+    node.config.bootstrap_retry_delay_s = 5.0
+    c.network.add_filter(lambda f, t, m: isinstance(m, FetchSnapshot))
+
+    topo2 = Topology(2, [Shard(Range(0, 500), [1, 2, 3]),
+                         Shard(Range(500, 1000), [2, 3, 4])])
+    _install(c, 1, topo2)
+    c.process_all()
+
+    begins = _flight(node, "bootstrap_begin")
+    assert [e[4] for e in begins] == [(2, 1), (2, 2)], begins
+    dones = _flight(node, "bootstrap_done")
+    assert dones and dones[-1][4] == (2, 2, "failed"), dones
+    # exponential backoff: the second attempt starts at least one full
+    # retry delay (5s virtual) after the first began
+    assert begins[1][0] - begins[0][0] >= 5_000_000
+    # honesty: nothing fetched, nothing served
+    snap = node.data_store.snapshot_ranges(topo2.ranges_for_node(4))
+    assert not snap, snap
+
+
+# ------------------------------------------- checkpoint-resume fetch pin ----
+
+def test_crash_between_checkpoint_and_completion_resumes_not_restarts(
+        tmp_path):
+    """Crash mid-bootstrap with one range checkpointed: the restart must
+    resume from the checkpointed coverage — the WAL replay reinstalls the
+    fetched snapshot and the resumed bootstrap NEVER re-fetches completed
+    ranges (pinned by inspecting every post-restart FetchSnapshot)."""
+    from accord_tpu.messages.epoch import FetchSnapshot
+
+    c = SimCluster(n_nodes=3, seed=9, n_shards=2, rf=3,
+                   journal_dir=str(tmp_path))
+    c.process_all()
+    for tok, val in ((100, 1), (600, 2)):
+        _write(c, 1, tok, val)
+    c.process_all()
+
+    node = c._build_node(4)
+    c.process_all()
+    node.config.bootstrap_fetch_timeout_s = 2.0
+    node.config.bootstrap_max_retries = 6
+    node.config.bootstrap_retry_delay_s = 5.0
+    # range B = [500, 1000) is unfetchable; range A = [0, 500) lands and
+    # is checkpointed by the partial finalize
+    blocked = Range(500, 1000)
+
+    def drop_b(f, t, m):
+        return isinstance(m, FetchSnapshot) and \
+            any(r.intersects(blocked) for r in m.ranges)
+    c.network.add_filter(drop_b)
+
+    topo2 = Topology(2, [Shard(Range(0, 500), [1, 2, 4]),
+                         Shard(Range(500, 1000), [2, 3, 4])])
+    _install(c, 1, topo2)
+    c.process_until(
+        lambda: bool(_flight(c.nodes[4], "bootstrap_checkpoint")),
+        max_items=2_000_000)
+    # crash strictly between the checkpoint and bootstrap completion
+    assert not any(e[4][2] == "ok"
+                   for e in _flight(c.nodes[4], "bootstrap_done"))
+    c.kill_node(4)
+    c.process_all()
+    c.network.remove_filter(drop_b)
+
+    refetched = []
+
+    def count_fetches(f, t, m):
+        if isinstance(m, FetchSnapshot):
+            refetched.extend(m.ranges)
+        return False
+    c.network.add_filter(count_fetches)
+    node = c.restart_node(4)
+    c.process_all()
+
+    # the resume fetched ONLY the un-checkpointed remainder
+    assert refetched, "restart never resumed the interrupted bootstrap"
+    fenced = Range(0, 500)
+    assert not any(r.intersects(fenced) for r in refetched), refetched
+    # and the node ends complete: checkpointed data via replay, the
+    # remainder via the resumed fetch
+    snap = {k.token: v for k, v in node.data_store.snapshot_ranges(
+        topo2.ranges_for_node(4)).items()}
+    assert set(snap) == {100, 600}, snap
+    assert any(e[4][2] == "ok" for e in _flight(node, "bootstrap_done"))
+
+
+# ------------------------------------------------ tier-1 TCP convergence ----
+
+def test_tcp_epoch_install_converges_on_three_node_cluster():
+    """Tier-1 smoke: one admin contact installs a new epoch on a live
+    3-node TCP cluster; every node converges (journaled before the ack,
+    gossiped to the rest) and serves the new topology spec."""
+    from accord_tpu.host.maelstrom import TOKEN_SPAN
+    from accord_tpu.host.tcp import TcpClusterClient
+
+    c = TcpClusterClient(n_nodes=3, n_shards=4)
+    try:
+        spec = c.refresh_topology(contact=2)
+        assert spec and spec["epoch"] == 1
+        width = TOKEN_SPAN // 4
+        shards = [[i * width,
+                   TOKEN_SPAN if i == 3 else (i + 1) * width,
+                   [1 + (i + j) % 3 for j in range(3)]]
+                  for i in range(4)]
+        ok = c.install_epoch(2, shards, contact=1)
+        assert ok is not None and ok.get("epoch", 0) >= 2, ok
+        assert c.wait_epoch(2, timeout_s=30.0), "epoch 2 never converged"
+        spec = c.refresh_topology(contact=3)
+        assert spec["epoch"] == 2
+        # routing refresh satellite: the cached spec now answers owner_of
+        assert c.owner_of(0) in {n for _s, _e, ns in spec["shards"]
+                                 for n in ns}
+    finally:
+        c.close()
+
+
+# ------------------------------------------------------- nemesis arms ------
+
+@pytest.mark.slow
+def test_nemesis_kill_joining_node_mid_bootstrap_restart_completes(
+        tmp_path, monkeypatch):
+    """Arm 1: SIGKILL the joining node while it bootstraps under a live
+    epoch change; its journal-backed restart must complete the join (epoch
+    replayed or re-gossiped, bootstrap resumed from any checkpointed
+    coverage) and serve every previously-acked value."""
+    from accord_tpu.host.maelstrom import TOKEN_SPAN
+    from accord_tpu.host.tcp import TcpClusterClient
+
+    monkeypatch.setenv("ACCORD_JOURNAL", str(tmp_path))
+    c = TcpClusterClient(n_nodes=3, n_shards=4)
+    try:
+        acked = {}
+        outstanding = set()
+        for i in range(40):
+            tok = i % 8
+            c.submit(1 + i % 3, [tok], {tok: 1000 + i}, i)
+            outstanding.add(i)
+        deadline = time.monotonic() + 60.0
+        while outstanding and time.monotonic() < deadline:
+            frame = c.recv(1.0)
+            if frame is None:
+                continue
+            body = frame.get("body", {})
+            if body.get("type") == "submit_reply" \
+                    and body.get("req") in outstanding:
+                outstanding.discard(body["req"])
+                if body.get("ok"):
+                    i = body["req"]
+                    acked.setdefault(i % 8, []).append(1000 + i)
+        assert acked, "no acked appends to verify against"
+
+        joined = c.add_node()
+        width = TOKEN_SPAN // 4
+        shards = [[i * width,
+                   TOKEN_SPAN if i == 3 else (i + 1) * width,
+                   [[1, 2, 3, 4][(i + j) % 4] for j in range(3)]]
+                  for i in range(4)]
+        ok = c.install_epoch(2, shards, peers=c.peer_specs([joined]),
+                             contact=1)
+        assert ok is not None, "epoch install never acked"
+        time.sleep(0.05)  # let the joiner get into (or through) bootstrap
+        c.kill_node(joined)
+        time.sleep(0.5)
+        c.restart_node(joined)
+        assert c.wait_epoch(2, nodes=[joined], timeout_s=60.0), \
+            "restarted joiner never converged on epoch 2"
+
+        # the joiner serves: coordinate reads THROUGH it and check every
+        # acked append survived the mid-join crash
+        for tok, vals in sorted(acked.items()):
+            req = f"r-{tok}"
+            c.submit(joined, [tok], {}, req)
+            got = None
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                frame = c.recv(1.0)
+                if frame is None:
+                    continue
+                body = frame.get("body", {})
+                if body.get("type") == "submit_reply" \
+                        and body.get("req") == req:
+                    got = body
+                    break
+            assert got is not None and got.get("ok"), got
+            read = (got.get("reads") or {}).get(str(tok)) or \
+                (got.get("reads") or {}).get(tok) or []
+            for val in vals:
+                assert val in read, (tok, val, read)
+    finally:
+        c.close()
+
+
+@pytest.mark.slow
+def test_nemesis_member_down_during_install_converges_via_gossip(
+        tmp_path, monkeypatch):
+    """Arm 2: the epoch installs while one member is unreachable (killed —
+    the live-host partition); one admin contact still suffices, and the
+    revived member converges through the install gossip without any
+    second admin action."""
+    from accord_tpu.host.maelstrom import TOKEN_SPAN
+    from accord_tpu.host.tcp import TcpClusterClient
+
+    monkeypatch.setenv("ACCORD_JOURNAL", str(tmp_path))
+    c = TcpClusterClient(n_nodes=3, n_shards=4)
+    try:
+        c.kill_node(3)
+        width = TOKEN_SPAN // 4
+        shards = [[i * width,
+                   TOKEN_SPAN if i == 3 else (i + 1) * width,
+                   [1 + (i + j) % 3 for j in range(3)]]
+                  for i in range(4)]
+        ok = c.install_epoch(2, shards, contact=1)
+        assert ok is not None and ok.get("epoch", 0) >= 2
+        assert c.wait_epoch(2, nodes=[1, 2], timeout_s=30.0)
+        c.restart_node(3)
+        assert c.wait_epoch(2, nodes=[3], timeout_s=45.0), \
+            "revived member never learned epoch 2 from gossip"
+    finally:
+        c.close()
+
+
+@pytest.mark.slow
+def test_nemesis_crash_of_draining_node_loses_no_acks(tmp_path,
+                                                      monkeypatch):
+    """Arm 3: SIGKILL a node mid-drain, before the handoff completes —
+    every append it ever acked must still be readable from the surviving
+    quorum (acks were durability-gated, not resident-only)."""
+    from accord_tpu.host.tcp import TcpClusterClient
+
+    monkeypatch.setenv("ACCORD_JOURNAL", str(tmp_path))
+    c = TcpClusterClient(n_nodes=3, n_shards=4)
+    try:
+        acked = {}
+        outstanding = set()
+        for i in range(60):
+            tok = i % 10
+            c.submit(3, [tok], {tok: 2000 + i}, i)
+            outstanding.add(i)
+        deadline = time.monotonic() + 60.0
+        while outstanding and time.monotonic() < deadline:
+            frame = c.recv(1.0)
+            if frame is None:
+                continue
+            body = frame.get("body", {})
+            if body.get("type") == "submit_reply" \
+                    and body.get("req") in outstanding:
+                outstanding.discard(body["req"])
+                if body.get("ok"):
+                    i = body["req"]
+                    acked.setdefault(i % 10, []).append(2000 + i)
+        assert acked, "no acked appends to verify against"
+
+        # drain, then crash before the drain can possibly finish
+        c._send(3, {"type": "drain", "req": "dr-3", "timeout_s": 30.0})
+        c.kill_node(3)
+
+        for tok, vals in sorted(acked.items()):
+            req = f"r-{tok}"
+            c.submit(1, [tok], {}, req)
+            got = None
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                frame = c.recv(1.0)
+                if frame is None:
+                    continue
+                body = frame.get("body", {})
+                if body.get("type") == "submit_reply" \
+                        and body.get("req") == req:
+                    got = body
+                    break
+            assert got is not None and got.get("ok"), got
+            read = (got.get("reads") or {}).get(str(tok)) or \
+                (got.get("reads") or {}).get(tok) or []
+            for val in vals:
+                assert val in read, (tok, val, read)
+    finally:
+        c.close()
+
+
+@pytest.mark.slow
+def test_reshard_under_load_zero_lost_acks_and_audit_agreement():
+    """The full tentpole, end to end: open-loop zipfian over the live TCP
+    cluster with a complete mid-window membership reshard (join +
+    bootstrap under load, epoch gossip, client routing refresh, drain +
+    retire).  Zero acked appends lost, the cross-replica audit digests
+    agree at quiesce, and the lane measured an SLO recovery."""
+    from accord_tpu.workload.openloop import run_reshard_tcp
+
+    run = run_reshard_tcp(ops=400, rate_per_s=60.0, reshard_at_frac=0.3,
+                          seed=17, settle_timeout_s=60.0)
+    rep = run.report
+    rs = rep["reshard"]
+    assert rep["counts"]["pending"] == 0, rep["counts"]
+    assert rep["counts"]["acked"] > 0.5 * 400, rep["counts"]
+    assert rs["lost_acks"] == 0, rs["lost_detail"]
+    assert rs["audit"]["agree"], rs["audit"]
+    assert rs["time_to_slo_recovery_s"] is not None, rs
+    labels = [label for label, _at in rs["events"]]
+    for must in ("reshard_begin", "node_added", "epoch_converged",
+                 "routing_refreshed", "drain_ok", "retired",
+                 "reshard_end"):
+        assert must in labels, (must, labels)

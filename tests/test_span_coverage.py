@@ -186,6 +186,18 @@ def test_audit_kinds_are_covered():
             (kind, recorded[kind])
 
 
+def test_elasticity_kinds_are_covered():
+    """The live-elasticity plane's forensics hooks must stay on the ring:
+    epoch installs, bootstrap attempt begin/checkpoint/done, and the
+    scale-in drain lifecycle.  Pinned as a SET like the journal lifecycle
+    below, so a hook cannot vanish together with its EVENT_KINDS row."""
+    recorded = _recorded_flight_kinds()
+    for kind in ("epoch_install", "bootstrap_begin", "bootstrap_checkpoint",
+                 "bootstrap_done", "drain_begin", "drain_done"):
+        assert kind in EVENT_KINDS, f"{kind} missing from EVENT_KINDS"
+        assert kind in recorded, f"nothing records {kind}"
+
+
 def test_frame_coalescing_kinds_are_covered():
     """The transport egress buffer's forensics hooks must stay on the
     ring: every message captured into a peer's coalescing buffer

@@ -247,7 +247,44 @@ def _synthesize(gen: _Gen):
         # randomized Known vectors incl. the InvalidIf lattice component
         gen.check_status_ok(),
     ]
+    out.extend(_synthesize_admin(gen))
     return out
+
+
+def _synthesize_admin(gen: _Gen):
+    """The live-elasticity admin plane (messages/admin.py): epoch installs
+    gossip between hosts and are journaled; drain markers and bootstrap
+    checkpoints are journaled — every one must survive the codec or a
+    restarted node replays a corrupted membership/progress story."""
+    from accord_tpu.messages.admin import (BootstrapCheckpoint, BootstrapDone,
+                                           DrainBegin, DrainDone,
+                                           EpochInstall, TopologyFetchNack,
+                                           TopologyFetchOk, TopologyFetchReq)
+    from accord_tpu.primitives.keys import Key
+    from accord_tpu.primitives.timestamp import Domain, TxnKind
+
+    epoch = 2 + gen.rng.next_int(0, 5)
+    mid = 100 + gen.token()
+    install = EpochInstall(
+        epoch,
+        ((0, mid, (1, 2, 3)), (mid, 1000, (2, 3, 4))),
+        peers=((4, "127.0.0.1", 10_000 + gen.rng.next_int(0, 50_000)),))
+    fence = gen.txn_id(kind=TxnKind.EXCLUSIVE_SYNC_POINT, domain=Domain.RANGE)
+    return [
+        install,
+        EpochInstall(epoch, ((0, 1000, (1, 2)),)),  # peers=None arm
+        TopologyFetchReq(epoch),
+        TopologyFetchOk(install),
+        TopologyFetchNack(epoch),
+        DrainBegin(1 + gen.rng.next_int(0, 3)),
+        DrainDone(1 + gen.rng.next_int(0, 3)),
+        BootstrapCheckpoint(
+            epoch, fence, gen.ranges(),
+            {Key(gen.token()): ((gen.ts(), 1 + gen.token()),)},
+            max_conflict=gen.ts(), max_applied=gen.ts()),
+        BootstrapCheckpoint(epoch, fence, gen.ranges(), {}),  # sparse arm
+        BootstrapDone(epoch, gen.ranges()),
+    ]
 
 
 def _assert_round_trip(msg) -> None:
