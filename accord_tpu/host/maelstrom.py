@@ -267,6 +267,10 @@ class MaelstromHost:
             # transport — journaled before the ack, gossiped so one
             # contacted node converges the whole membership
             self._handle_admin_epoch(src, body)
+        elif typ == "admin_drain":
+            # admin plane: scale-in — fence, hand off in-flight work, wait
+            # the durability barrier, retire without losing an ack
+            self._handle_admin_drain(src, body)
 
     def _handle_admin_epoch(self, client: str, body: dict) -> None:
         from accord_tpu.messages.admin import EpochInstall
@@ -281,9 +285,65 @@ class MaelstromHost:
                             "in_reply_to": body.get("msg_id"),
                             "epoch": self.node.epoch})
 
+    def _handle_admin_drain(self, client: str, body: dict) -> None:
+        """`{"type":"admin_drain"}`: scale-in this node (the TCP host's
+        drain ladder, host/tcp.py:_admin_drain, over Maelstrom envelopes).
+        DrainBegin fences new client coordination (journaled: a crashed
+        drainer comes back fenced) and tells peers to deprioritize us as a
+        fetch source; then we wait for in-flight coordinations to settle,
+        raise a GLOBAL_SYNC durability barrier over our ranges, and only
+        then ack + DrainDone."""
+        from accord_tpu.messages.admin import DrainBegin, DrainDone
+        node = self.node
+        msg_id = body.get("msg_id")
+        topology = node.topology.current()
+        members = sorted(n for n in topology.nodes() if n != node.id)
+        node.receive(DrainBegin(node.id), 0, None)
+        for to in members:
+            node.send(to, DrainBegin(node.id))
+        deadline = time.monotonic() + float(body.get("timeout_s", 60.0))
+
+        def finish(_v=None, failure=None):
+            node.receive(DrainDone(node.id), 0, None)
+            for to in members:
+                node.send(to, DrainDone(node.id))
+            if self.wal is not None:
+                self.wal.sync()  # every acked write is on disk before we go
+            self._emit(client, {"type": "admin_drain_ok",
+                                "in_reply_to": msg_id, "node": node.id,
+                                "durable": failure is None})
+
+        def durability_barrier():
+            owned = topology.ranges_for_node(node.id)
+            if owned.is_empty:
+                # the current epoch already moved everything away; older
+                # in-flight work still needs the watermark — barrier all
+                owned = Ranges([s.range for s in topology.shards])
+            from accord_tpu.coordinate.syncpoint import BarrierType, barrier
+            barrier(node, owned, BarrierType.GLOBAL_SYNC) \
+                .add_callback(finish)
+
+        def wait_idle():
+            # hand off in-flight work: poll until nothing this node is
+            # coordinating remains (new client work is already fenced)
+            if not node.coordinating or time.monotonic() >= deadline:
+                durability_barrier()
+                return
+            self.scheduler.once(0.05, wait_idle)
+
+        wait_idle()
+
     def _handle_txn(self, client: str, body: dict) -> None:
         ops = body["txn"]
         msg_id = body.get("msg_id")
+        if self.node.draining:
+            # drain fence: never coordinated — Maelstrom code 11 is
+            # temporarily-unavailable (retriable), so the workload remaps
+            # to another coordinator instead of losing the op
+            self._emit(client, {"type": "error", "in_reply_to": msg_id,
+                                "code": 11, "text": "draining",
+                                "drained": True})
+            return
         reads = []
         appends: Dict[Key, int] = {}
         for op, k, v in ops:

@@ -205,6 +205,27 @@ class MaelstromRunner:
         assert rec["reply"]["type"] == "admin_epoch_ok", rec["reply"]
         return rec["reply"]
 
+    def drain_node(self, name: str, deadline_s: float = 60.0) -> dict:
+        """Admin-plane scale-in over the Maelstrom transport: ask `name` to
+        drain — fence new client work, hand off in-flight coordinations,
+        raise the GLOBAL_SYNC durability barrier — and wait for its
+        admin_drain_ok (whose `durable` flag reports the barrier verdict)."""
+        self._msg_seq += 1
+        msg_id = self._msg_seq
+        self.pending[msg_id] = {"msg_id": msg_id, "client": "c0",
+                                "ops": [], "start_us": 0, "reply": None}
+        self.procs[name].send({"src": "c0", "dest": name,
+                               "body": {"type": "admin_drain",
+                                        "msg_id": msg_id}})
+        ok = self.pump_until(
+            lambda: any(r["msg_id"] == msg_id for r in self.results),
+            deadline_s)
+        assert ok, f"admin_drain never acked by {name}"
+        rec = next(r for r in self.results if r["msg_id"] == msg_id)
+        self.results.remove(rec)
+        assert rec["reply"]["type"] == "admin_drain_ok", rec["reply"]
+        return rec["reply"]
+
     # ------------------------------------------------------------- client --
     def init_all(self) -> None:
         for name, hp in self.procs.items():

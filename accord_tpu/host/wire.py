@@ -51,6 +51,7 @@ _MODULES = [
     "accord_tpu.messages.multi",
     "accord_tpu.messages.audit",
     "accord_tpu.messages.admin",
+    "accord_tpu.messages.paging",
     "accord_tpu.impl.list_store",
     "accord_tpu.coordinate.errors",
     "accord_tpu.pipeline.backpressure",

@@ -63,6 +63,28 @@ def scan_segment(path: str) -> Tuple[List[bytes], int, bool]:
     return records, good, torn
 
 
+def read_frame_at(path: str, offset: int) -> bytes:
+    """Read exactly ONE frame starting at `offset` — the point-read a
+    fault-index hit performs, so a refault costs one seek + one frame, not
+    a segment scan.  Raises ValueError on a bad offset, torn frame, or CRC
+    mismatch: the caller (the pager) treats that as spill-tier corruption,
+    never as a missing command."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        header = f.read(_HEADER.size)
+        if len(header) != _HEADER.size:
+            raise ValueError(f"truncated frame header at {path}:{offset}")
+        length, crc = _HEADER.unpack(header)
+        if length > MAX_RECORD_BYTES:
+            raise ValueError(f"oversized frame at {path}:{offset}")
+        payload = f.read(length)
+    if len(payload) != length:
+        raise ValueError(f"truncated frame payload at {path}:{offset}")
+    if zlib.crc32(payload) != crc:
+        raise ValueError(f"frame CRC mismatch at {path}:{offset}")
+    return payload
+
+
 def read_segment(path: str, truncate: bool = True) -> List[bytes]:
     """Records of one segment; with `truncate`, a torn tail is cut back to
     the last intact record on disk (fsynced) so later appends are safe."""

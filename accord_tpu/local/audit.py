@@ -145,6 +145,24 @@ def _walk_decided(node, ranges: Ranges, lo: Timestamp, hi: Timestamp):
                 continue
             seen.add(txn_id)
             yield txn_id, ec[0], ec[1]
+        # the paging tier (local/paging.py): spilled commands contribute
+        # the SAME (class, executeAt, scope) their resident husks would —
+        # captured at spill time, which is sound because every evictable
+        # status is decision-terminal (eviction must never perturb the
+        # cross-replica digests)
+        pager = getattr(store, "pager", None)
+        if pager is None:
+            continue
+        for txn_id, m in list(pager.meta.items()):
+            if txn_id in seen or txn_id < lo or not (txn_id < hi):
+                continue
+            ec = m[0]
+            if ec is None:
+                continue
+            if not _in_ranges(m[1], ranges):
+                continue
+            seen.add(txn_id)
+            yield txn_id, ec[0], ec[1]
 
 
 def digest_node(node, ranges: Ranges, lo: Timestamp, hi: Timestamp
@@ -269,8 +287,30 @@ def census_node(node, byte_sample: int = 48) -> dict:
     cfk_entries = 0
     gated = 0
     range_cmds = 0
+    spilled_total = 0
+    spilled_by_class: Dict[str, int] = {}
+    spilled_uncleaned = 0
+    cfk_spilled = 0
+    paging = None
     floors = {k: None for k in _WATERMARK_KINDS}
     for store in node.command_stores.all():
+        # the paging tier: spilled state is evicted, NOT leaked — it must
+        # stay visible to the census (and count against the leak detector
+        # exactly as if resident).  Aggregates are maintained incrementally
+        # by the pager so this sweep stays O(stores), not O(spilled).
+        pager = getattr(store, "pager", None)
+        if pager is not None:
+            spilled_total += len(pager.meta)
+            for cls, n in pager.spilled_by_class.items():
+                spilled_by_class[cls] = spilled_by_class.get(cls, 0) + n
+            spilled_uncleaned += pager.spilled_uncleaned
+            cfk_spilled += len(pager.cfk_residuals)
+            s = pager.stats()
+            if paging is None:
+                paging = dict(s)
+            else:
+                for k, v in s.items():
+                    paging[k] += v
         cfk_keys += len(store.cfks)
         cfk_entries += sum(cfk.size() for cfk in store.cfks.values())
         gated += len(store.gated)
@@ -323,13 +363,22 @@ def census_node(node, byte_sample: int = 48) -> dict:
         "resident": total,
         "by_class": by_class,
         "by_durability": by_durability,
-        "quiescent_uncleaned": quiescent_uncleaned,
+        # quiescent-but-uncleaned counts BOTH tiers: eviction moves a
+        # command resident->spilled without changing this total, so the
+        # leak detector cannot false-trip on paging (nor can paging hide
+        # a genuine cleanup strand)
+        "quiescent_uncleaned": quiescent_uncleaned + spilled_uncleaned,
         "resident_bytes_est": est_bytes,
+        "spilled": spilled_total,
+        "spilled_by_class": spilled_by_class,
+        "spilled_quiescent_uncleaned": spilled_uncleaned,
+        "paging": paging,
         "age_us": {"p50": _quantile(ages, 0.50),
                    "p95": _quantile(ages, 0.95),
                    "max": ages[-1] if ages else 0,
                    "count": len(ages)},
-        "cfk": {"keys": cfk_keys, "entries": cfk_entries},
+        "cfk": {"keys": cfk_keys, "entries": cfk_entries,
+                "spilled": cfk_spilled},
         "gated": gated,
         "range_commands": range_cmds,
         "watermarks": watermarks,
@@ -650,6 +699,24 @@ class Auditor:
         reg.counter("accord_census_sweeps_total").inc()
         for cls, n in census["by_class"].items():
             reg.gauge("accord_census_resident", node=nid, cls=cls).set(n)
+        # tier-labeled view (resident|spilled): evicted-but-live state must
+        # not vanish from accord_census_* — the spilled tier is published
+        # beside the resident one under the same class buckets
+        for cls, n in census["by_class"].items():
+            reg.gauge("accord_census_commands", node=nid, cls=cls,
+                      tier="resident").set(n)
+        for cls, n in census["spilled_by_class"].items():
+            reg.gauge("accord_census_commands", node=nid, cls=cls,
+                      tier="spilled").set(n)
+        reg.gauge("accord_census_spilled_total", node=nid).set(
+            census["spilled"])
+        paging = census.get("paging")
+        if paging is not None:
+            for k in ("hits", "misses", "evictions", "refaults",
+                      "resident", "resident_high_water", "spilled",
+                      "cfk_evictions", "cfk_restores", "spill_disk_bytes",
+                      "spill_compactions"):
+                reg.gauge(f"accord_pager_{k}", node=nid).set(paging[k])
         for d, n in census["by_durability"].items():
             reg.gauge("accord_census_resident_by_durability", node=nid,
                       durability=d).set(n)

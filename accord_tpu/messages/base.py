@@ -83,6 +83,12 @@ class MessageType(enum.Enum):
     DRAIN_DONE_MSG = ("DRAIN_DONE_MSG", True)
     BOOTSTRAP_CHECKPOINT_MSG = ("BOOTSTRAP_CHECKPOINT_MSG", True)
     BOOTSTRAP_DONE_MSG = ("BOOTSTRAP_DONE_MSG", True)
+    # bounded-memory paging tier (messages/paging.py): spill frames and
+    # fault-index checkpoints live in the pager's per-incarnation spill
+    # store, NEVER the node WAL — has_side_effects=False keeps the live
+    # journal path from ever framing one
+    SPILL_FRAME_MSG = ("SPILL_FRAME_MSG", False)
+    FAULT_INDEX_CHECKPOINT_MSG = ("FAULT_INDEX_CHECKPOINT_MSG", False)
     SIMPLE_RSP = ("SIMPLE_RSP", False)
     FAILURE_RSP = ("FAILURE_RSP", False)
     # local-only (never cross the network; applied via Node.local_request)

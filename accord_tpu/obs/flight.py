@@ -110,6 +110,15 @@ EVENT_KINDS = {
                    "(messages/admin.py); data=(node_id, from_id)",
     "drain_done": "retiring node durably handed off + retired "
                   "(messages/admin.py); data=(node_id, from_id)",
+    "cmd_evict": "quiescent command evicted from the resident tier to the "
+                 "spill store (local/paging.py), trace id = the evicted "
+                 "txn; data=(store_id, save_status)",
+    "cmd_fault": "spilled command faulted back resident on access — one "
+                 "fault-index point read (local/paging.py), trace id = "
+                 "the faulted txn; data=(store_id, save_status)",
+    "page_spill": "spill frame appended to the paging tier's on-disk "
+                  "store (journal/fault_index.py); data=(segment, offset, "
+                  "payload_bytes)",
 }
 
 

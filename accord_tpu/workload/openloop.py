@@ -116,6 +116,7 @@ def run_open_loop_sim(profile: str = "zipfian", ops: int = 400,
                       rate_per_s: float = 400.0, schedule: str = "poisson",
                       seed: int = 0, nodes: int = 3, keys: int = 48,
                       n_shards: int = 4, pipeline: bool = True,
+                      token_span: int = 1000,
                       stall_at_us: Optional[int] = None, stall_us: int = 0,
                       store_factory: Optional[Callable] = None,
                       profile_kwargs: Optional[dict] = None,
@@ -132,8 +133,8 @@ def run_open_loop_sim(profile: str = "zipfian", ops: int = 400,
 
     rng = RandomSource(seed)
     cluster = SimCluster(n_nodes=nodes, seed=rng.next_long(),
-                         n_shards=n_shards, pipeline=pipeline,
-                         store_factory=store_factory)
+                         token_span=token_span, n_shards=n_shards,
+                         pipeline=pipeline, store_factory=store_factory)
     cluster.start_durability_scheduling(shard_cycle_s=10.0)
     prof = make_profile(profile, keys=keys, seed=rng.next_long(),
                         **(profile_kwargs or {}))

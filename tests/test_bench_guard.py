@@ -353,3 +353,64 @@ def test_reshard_guard_dry_run_rejects_lost_ack_rows(tmp_path):
                 {"ACCORD_BENCH_HISTORY": str(hist)})
     assert proc.returncode != 0
     assert "recovery" in (proc.stderr + proc.stdout)
+
+
+# ------------------------------ bounded-memory lane (ISSUE 14) --
+
+def test_zipf1m_guard_dry_run_validates_paging_row_schema():
+    """The recorded slo-zipf1m row must stay guard-parseable AND carry
+    the bounded-memory verdicts the lane exists for: a resident cap far
+    below the working set, the high-water/hit-rate/eviction counters,
+    zero lost acks, and cross-replica audit agreement at quiesce — on
+    the exact-sample quantile path like every SLO lane."""
+    proc = _run(["--config", "slo-zipf1m", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "slo-zipf1m_guard" and row["dry_run"] is True
+    assert row["baselines"], "no slo-zipf1m baseline in BENCH_HISTORY.json"
+    assert row["baselines"][0]["slo_open_p99_us"] > 0
+    hist = json.load(open(os.path.join(
+        REPO, os.environ.get("ACCORD_BENCH_HISTORY",
+                             "BENCH_HISTORY.json"))))
+    slo = hist["slo-zipf1m"]["host"]["slo"]
+    assert slo["quantile_source"] == "exact-sample"
+    pg = slo["paging"]
+    assert pg["lost_acks"] == 0 and pg["audit_agree"] is True
+    assert pg["evictions"] > 0 and pg["refaults"] > 0
+    # the bounded-memory claim the row records: the cap AND the observed
+    # resident high-water are small fractions of the acked working set
+    assert pg["cap"] < 0.10 * pg["working_set"], pg
+    assert pg["resident_high_water"] < 0.10 * pg["working_set"], pg
+    assert 0.0 < pg["hit_rate"] <= 1.0
+
+
+def test_zipf1m_guard_dry_run_rejects_broken_paging_rows(tmp_path):
+    """A zipf1m row recording lost acks, an audit divergence, or a
+    stripped paging section must fail the dry run — a broken bounded-
+    memory baseline must fail CI, not silently keep gating."""
+    good = json.load(open(os.path.join(REPO, "BENCH_HISTORY.json")))
+    hist = tmp_path / "hist.json"
+
+    lane = json.loads(json.dumps(good["slo-zipf1m"]))  # deep copy
+    lane["host"]["slo"]["paging"]["lost_acks"] = 3
+    hist.write_text(json.dumps({"slo-zipf1m": lane}))
+    proc = _run(["--config", "slo-zipf1m", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "lost acks" in (proc.stderr + proc.stdout)
+
+    lane = json.loads(json.dumps(good["slo-zipf1m"]))
+    lane["host"]["slo"]["paging"]["audit_agree"] = False
+    hist.write_text(json.dumps({"slo-zipf1m": lane}))
+    proc = _run(["--config", "slo-zipf1m", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "divergence" in (proc.stderr + proc.stdout)
+
+    lane = json.loads(json.dumps(good["slo-zipf1m"]))
+    del lane["host"]["slo"]["paging"]["resident_high_water"]
+    hist.write_text(json.dumps({"slo-zipf1m": lane}))
+    proc = _run(["--config", "slo-zipf1m", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "resident_high_water" in (proc.stderr + proc.stdout)

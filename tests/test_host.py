@@ -188,6 +188,49 @@ class TestBlackBoxCluster:
             runner.close()
 
 
+@pytest.mark.slow
+class TestMaelstromDrain:
+    def test_drained_node_sheds_and_reaches_durability_barrier(self):
+        """The scale-in admin verb over the Maelstrom transport (mirrors
+        the TCP host's drain ladder): after some acked history, draining a
+        node must reach the GLOBAL_SYNC durability barrier (`durable`
+        true in the ack), and the drained node must shed subsequent client
+        submits with the retriable Maelstrom error — never coordinate
+        them — while the remaining members keep serving."""
+        from accord_tpu.host.runner import MaelstromRunner
+        runner = MaelstromRunner(n_nodes=3, seed=11)
+        try:
+            runner.init_all()
+            stats = runner.run_workload(n_ops=10, n_keys=4)
+            assert stats["acked"] >= 8, stats
+
+            reply = runner.drain_node("n2")
+            assert reply["durable"] is True, reply
+
+            # drain fence: the drained node sheds, retriable for remap
+            msg_id = runner.submit_txn("c9", [["append", 3, 9001]], to="n2")
+            assert runner.pump_until(
+                lambda: any(r["msg_id"] == msg_id for r in runner.results),
+                30.0)
+            rec = next(r for r in runner.results if r["msg_id"] == msg_id)
+            runner.results.remove(rec)
+            assert rec["reply"]["type"] == "error", rec["reply"]
+            assert rec["reply"]["code"] == 11, rec["reply"]
+            assert rec["reply"].get("drained") is True, rec["reply"]
+
+            # the surviving members still coordinate client work
+            msg2 = runner.submit_txn("c9", [["append", 3, 9002],
+                                            ["r", 3, None]], to="n1")
+            assert runner.pump_until(
+                lambda: any(r["msg_id"] == msg2 for r in runner.results),
+                30.0)
+            rec2 = next(r for r in runner.results if r["msg_id"] == msg2)
+            assert rec2["reply"]["type"] == "txn_ok", rec2["reply"]
+            assert 9002 in rec2["reply"]["txn"][1][2], rec2["reply"]
+        finally:
+            runner.close()
+
+
 class TestWireFastPaths:
     """The compact encodings for hot primitives (r3: packed-int timestamps,
     token arrays for key sets, int-tuple passthrough) and their guardrails."""

@@ -1,0 +1,430 @@
+"""Bounded-memory paging tier (local/paging.py + journal/fault_index.py).
+
+Reference: accord's pluggable storage seam — command state must be
+evictable and reloadable by identity without the protocol observing a
+missing command.  Three layers are pinned here:
+
+  * SpillStore unit tests: spill/fault point-reads, supersede, drop,
+    checkpoint-seeded reopen, and compaction repointing the fault index.
+  * Pager integration over the real sim protocol path: budget
+    enforcement, refault-then-truncate ordering, CFK shell evict/restore,
+    and the census/leak-detector contract (eviction is count-neutral —
+    spilled state neither false-trips the leak detector nor vanishes
+    from accord_census_*).
+  * Differential + crash-restart burns: the SAME seed with paging on
+    must produce bit-identical replica state and audit outcomes as
+    paging off, and must survive the crash-restart nemesis (WAL replay
+    re-derives residency; the spill store is per-incarnation scratch).
+"""
+
+import os
+
+import pytest
+
+from accord_tpu.impl.list_store import (ListQuery, ListRead, ListResult,
+                                        ListUpdate, ListWrite)
+from accord_tpu.local.command import Command
+from accord_tpu.local.status import Durability, SaveStatus
+from accord_tpu.primitives.deps import Deps, KeyDeps, RangeDeps
+from accord_tpu.primitives.keys import Key, Keys, Range, Route, RoutingKeys
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+from accord_tpu.primitives.txn import Txn
+from accord_tpu.primitives.writes import Writes
+
+
+def _tid(hlc, node=1):
+    return TxnId.create(1, hlc, TxnKind.WRITE, Domain.KEY, node)
+
+
+def _applied_cmd(hlc, node=1, durability=Durability.NOT_DURABLE):
+    """A synthetic quiescent APPLIED command with a full durable payload —
+    the spill-eligible shape (no listeners, waiting_on None)."""
+    t = _tid(hlc, node)
+    keys = RoutingKeys.of(1, 2)
+    route = Route(keys[0], keys=keys)
+    txn = Txn(TxnKind.WRITE, Keys.of(1, 2),
+              read=ListRead(Keys.of(1)), query=ListQuery(),
+              update=ListUpdate({Key(2): hlc}))
+    ts = t.as_timestamp()
+    cmd = Command(t)
+    cmd.save_status = SaveStatus.APPLIED
+    cmd.durability = durability
+    cmd.route = route
+    cmd.partial_txn = txn.slice(route.covering(), include_query=True)
+    cmd.execute_at = ts
+    cmd.partial_deps = Deps(KeyDeps.of({Key(1): {_tid(hlc + 1000)}}),
+                            RangeDeps.of({Range(0, 10): [_tid(hlc + 2000)]}))
+    cmd.stable_deps = cmd.partial_deps
+    cmd.writes = Writes(t, ts, Keys.of(2), ListWrite({Key(2): hlc}))
+    cmd.result = ListResult(t, ts, {Key(1): (4,)}, {Key(2): hlc})
+    return cmd
+
+
+def _store(tmp_path, **kw):
+    from accord_tpu.journal.fault_index import SpillStore
+    kw.setdefault("segment_bytes", 4096)  # force rotation under test load
+    return SpillStore(str(tmp_path / "spill"), **kw)
+
+
+class TestSpillStore:
+    def test_spill_fault_point_read_roundtrip(self, tmp_path):
+        """Every spilled command faults back field-identical via ONE
+        (segment, offset) point-read — across segment rotations."""
+        from accord_tpu.host.wire import encode_message
+        from accord_tpu.messages.paging import SpillFrame
+        s = _store(tmp_path)
+        cmds = {c.txn_id: c for c in (_applied_cmd(h) for h in
+                                      range(10, 90))}
+        for cmd in cmds.values():
+            s.spill(cmd)
+        assert len(s.index) == len(cmds)
+        assert len({seg for seg, _off in s.index.values()}) > 1, \
+            "test never rotated a segment"
+        for txn_id, orig in cmds.items():
+            back = s.fault(txn_id)
+            # the wire tree is the equality oracle for the full payload
+            assert encode_message(SpillFrame.from_command(back)) == \
+                encode_message(SpillFrame.from_command(orig))
+            assert txn_id not in s.index
+        assert s.frames_faulted == len(cmds)
+        s.close()
+
+    def test_supersede_repoints_to_latest_frame(self, tmp_path):
+        """Re-spilling a txn repoints its index entry: the fault must
+        return the LATEST spilled state, never the dead first frame."""
+        s = _store(tmp_path)
+        cmd = _applied_cmd(7)
+        s.spill(cmd)
+        first = s.index[cmd.txn_id]
+        cmd.durability = Durability.UNIVERSAL
+        s.spill(cmd)
+        assert s.index[cmd.txn_id] != first
+        assert s.fault(cmd.txn_id).durability == Durability.UNIVERSAL
+        s.close()
+
+    def test_drop_discards_without_read(self, tmp_path):
+        s = _store(tmp_path)
+        cmd = _applied_cmd(7)
+        s.spill(cmd)
+        assert s.drop(cmd.txn_id) is True
+        assert s.drop(cmd.txn_id) is False
+        assert cmd.txn_id not in s
+        assert s.frames_dropped == 1 and s.frames_faulted == 0
+        s.close()
+
+    def test_checkpoint_seeds_reopen(self, tmp_path):
+        """A clean-close reopen rebuilds the fault index from the newest
+        FaultIndexCheckpoint plus the frames appended after it."""
+        s = _store(tmp_path, checkpoint_every=8)
+        cmds = [_applied_cmd(h) for h in range(10, 40)]
+        for cmd in cmds:
+            s.spill(cmd)
+        faulted = cmds[0].txn_id
+        s.fault(faulted)
+        index_before = dict(s.index)
+        s.close(final_checkpoint=True)
+        s2 = _store(tmp_path, fresh=False, checkpoint_every=8)
+        assert s2.index == index_before
+        assert faulted not in s2.index, "a faulted (dead) frame resurrected"
+        back = s2.fault(cmds[-1].txn_id)
+        assert back.txn_id == cmds[-1].txn_id
+        s2.close()
+
+    def test_reopen_without_checkpoint_full_scans(self, tmp_path):
+        s = _store(tmp_path, checkpoint_every=0)
+        cmds = [_applied_cmd(h) for h in range(10, 22)]
+        for cmd in cmds:
+            s.spill(cmd)
+        index_before = dict(s.index)
+        s.close(final_checkpoint=False)
+        s2 = _store(tmp_path, fresh=False, checkpoint_every=0)
+        assert s2.index == index_before
+        assert s2.fault(cmds[3].txn_id).txn_id == cmds[3].txn_id
+        s2.close()
+
+    def test_compaction_repoints_live_frames(self, tmp_path, monkeypatch):
+        """Once the dead fraction crosses the threshold, live frames are
+        rewritten into fresh segments and every index entry repointed —
+        faults after compaction are still one frame read."""
+        from accord_tpu.journal import fault_index as fi
+        monkeypatch.setattr(fi, "COMPACT_MIN_BYTES", 1 << 12)
+        s = _store(tmp_path)
+        cmds = [_applied_cmd(h) for h in range(10, 90)]
+        for cmd in cmds:
+            s.spill(cmd)
+        for cmd in cmds[:60]:  # faults kill frames -> dead fraction grows
+            s.fault(cmd.txn_id)
+        assert s.compactions >= 1, (s.compactions, s.disk_bytes)
+        survivors = {c.txn_id for c in cmds[60:]}
+        assert set(s.index) == survivors
+        for txn_id in survivors:
+            assert s.fault(txn_id).txn_id == txn_id
+        s.close()
+
+
+# ----------------------------------------------- integration fixture ----
+
+CAP = 25
+
+
+@pytest.fixture(scope="module")
+def settled_run():
+    """One zipfian open-loop run through the REAL sim protocol path with
+    the resident tier capped, settled through durability/cleanup cycles
+    so eviction, refault, cleanup truncation, and CFK shell paging have
+    all engaged.  Shared by the integration tests below (read-mostly;
+    the mutating tests operate on commands they fault themselves)."""
+    from accord_tpu.workload import run_open_loop_sim
+    prev = os.environ.get("ACCORD_RESIDENT_CMDS")
+    os.environ["ACCORD_RESIDENT_CMDS"] = str(CAP)
+    try:
+        run = run_open_loop_sim(profile="zipfian", ops=300,
+                                rate_per_s=300.0, keys=4000,
+                                token_span=4000, seed=17,
+                                keep_cluster=True)
+    finally:
+        if prev is None:
+            os.environ.pop("ACCORD_RESIDENT_CMDS", None)
+        else:
+            os.environ["ACCORD_RESIDENT_CMDS"] = prev
+    cluster = run.cluster
+    end_s = cluster.now_s + 15.0
+    cluster.process_until(lambda: cluster.now_s >= end_s,
+                          max_items=50_000_000)
+    return run
+
+
+def _pagers(cluster):
+    for node in cluster.nodes.values():
+        for store in node.command_stores.all():
+            if store.pager is not None:
+                yield store, store.pager
+
+
+class TestPagerIntegration:
+    def test_paging_off_keeps_plain_dict(self):
+        """Unset budget => no pager and a PLAIN dict `commands` mapping:
+        paging off is bit-identical to the pre-paging store, not merely
+        equivalent."""
+        from accord_tpu.local.paging import node_paging_stats
+        from accord_tpu.sim.cluster import SimCluster
+        assert "ACCORD_RESIDENT_CMDS" not in os.environ
+        cluster = SimCluster(n_nodes=3, seed=1)
+        for node in cluster.nodes.values():
+            for store in node.command_stores.all():
+                assert store.pager is None
+                assert type(store.commands) is dict
+            assert node_paging_stats(node) is None
+
+    def test_budget_enforced_protocol_blind(self, settled_run):
+        """Every op settles (the protocol never sees a missing command)
+        while each store's resident tier is swept back under the cap at
+        op boundaries, with real spill traffic on disk."""
+        counts = settled_run.report["counts"]
+        assert counts["pending"] == 0 and counts["failed"] == 0, counts
+        assert counts["acked"] == 300, counts
+        engaged = 0
+        for _store, pager in _pagers(settled_run.cluster):
+            s = pager.stats()
+            assert s["resident"] <= CAP, s
+            if s["evictions"]:
+                engaged += 1
+                assert s["spill_disk_bytes"] > 0
+                assert s["refaults"] > 0 or s["spilled"] > 0
+        assert engaged > 0, "no store's budget ever forced an eviction"
+
+    def test_refault_then_truncate_ordering(self, settled_run):
+        """A fault kills the spill frame BEFORE the resident copy can be
+        mutated: truncating a refaulted command and re-evicting it must
+        spill the truncated state — the stale APPLIED frame can never
+        resurrect."""
+        from accord_tpu.local import commands as C
+        from accord_tpu.local.store import PreLoadContext, SafeCommandStore
+        store = pager = txn_id = None
+        for st, pg in _pagers(settled_run.cluster):
+            for cand, meta in pg.meta.items():
+                if meta[2] == "applied":
+                    store, pager, txn_id = st, pg, cand
+                    break
+            if txn_id is not None:
+                break
+        assert txn_id is not None, "no spilled APPLIED command to test with"
+
+        cmd = store.commands[txn_id]            # forced refault
+        assert cmd.save_status == SaveStatus.APPLIED
+        assert txn_id not in pager.spilled
+        assert txn_id not in pager.spill_store().index, \
+            "fault left a stale frame live in the index"
+
+        safe = SafeCommandStore(store, PreLoadContext.empty())
+        C.purge(safe, txn_id, erase=False, keep_outcome=True)
+        truncated = store.commands[txn_id]      # resident, no fault
+        assert truncated.save_status == SaveStatus.TRUNCATED_APPLY
+
+        pager._evict(txn_id, truncated)         # re-spill CURRENT state
+        assert txn_id in pager.spilled
+        back = store.commands[txn_id]           # refault again
+        assert back.save_status == SaveStatus.TRUNCATED_APPLY, \
+            "re-spill resurrected the pre-truncation frame"
+
+    def test_cfk_shells_evict_and_restore(self, settled_run):
+        """Cleanup-emptied CommandsForKey shells page out (object dropped,
+        key kept in the sorted index, watermarks in a residual) and the
+        next touch restores the residual without double-inserting the
+        index entry."""
+        store = pager = key = None
+        for st, pg in _pagers(settled_run.cluster):
+            if pg.cfk_evictions and pg.cfk_residuals:
+                store, pager = st, pg
+                key = next(iter(pg.cfk_residuals))
+                break
+        assert key is not None, "settle never paged out an empty CFK shell"
+        redundant_before, version, committed = pager.cfk_residuals[key]
+        assert key not in store.cfks
+        assert store._cfk_tokens.count(key.token) == 1
+
+        restores_before = pager.cfk_restores
+        cfk = store._cfk(key)
+        assert pager.cfk_restores == restores_before + 1
+        assert key not in pager.cfk_residuals
+        assert store.cfks[key] is cfk
+        assert cfk.redundant_before == redundant_before
+        assert cfk.version == version
+        assert cfk.committed_version == committed
+        assert store._cfk_tokens.count(key.token) == 1, \
+            "restore double-inserted the sorted-index entry"
+
+    def test_census_counts_spilled_and_eviction_is_count_neutral(
+            self, settled_run):
+        """The census/leak contract: spilled state stays visible under
+        its class buckets, quiescent-but-uncleaned counts BOTH tiers, and
+        evicting one more command changes neither the combined total nor
+        what the leak detector observes."""
+        from accord_tpu.local.audit import (_QUIESCENT_UNCLEANED,
+                                            census_node)
+        cluster = settled_run.cluster
+        node = next(n for n in cluster.nodes.values()
+                    for _s, p in _pagers(cluster) if p.evictions)
+        census = census_node(node)
+        assert census["spilled"] > 0
+        assert census["paging"] is not None
+        assert sum(census["spilled_by_class"].values()) == census["spilled"]
+
+        # count-neutrality: force-evict one resident quiescent command
+        store, pager = next((s, p) for s, p in _pagers(cluster)
+                            if s.node is node)
+        victim = next(
+            (tid for tid, cmd in list(store.commands.items())
+             if cmd.save_status in _QUIESCENT_UNCLEANED
+             and not cmd.listeners and not cmd.transient_listeners
+             and tid not in store.gated and not tid.is_range_domain
+             and tid not in store.range_commands), None)
+        assert victim is not None
+        before = census_node(node)
+        pager._evict(victim, store.commands[victim])
+        after = census_node(node)
+        assert after["quiescent_uncleaned"] == before["quiescent_uncleaned"]
+        assert after["spilled"] == before["spilled"] + 1
+        assert after["resident"] == before["resident"] - 1
+
+    def test_census_gauges_publish_spilled_tier(self, settled_run):
+        """accord_census_commands carries a tier label: evicted-but-live
+        state must not vanish from the metrics endpoint."""
+        cluster = settled_run.cluster
+        cluster.attach_auditors(interval_s=0.0)
+        total = 0
+        for a in cluster.auditors.values():
+            census = a.census_once()
+            assert not census["leak_alarm"]
+            for cls, n in census["spilled_by_class"].items():
+                got = a.registry.value("accord_census_commands",
+                                       node=census["node"], cls=cls,
+                                       tier="spilled")
+                assert got == n
+                total += n
+            assert a.registry.value("accord_pager_evictions",
+                                    node=census["node"]) \
+                == census["paging"]["evictions"]
+        assert total > 0, "no spilled state visible in any census"
+
+    def test_leak_detector_still_trips_on_genuine_strand(self):
+        """Paging must not blunt the leak detector: the combined
+        resident+spilled count it observes still alarms on monotonic
+        growth, and still re-arms on any cleanup-driven decrease."""
+        from accord_tpu.obs.audit import LeakDetector
+        det = LeakDetector(min_growth=10, sweeps=3)
+        grows = [det.observe(c) for c in (0, 10, 20, 30, 40)]
+        assert any(grows), "monotonic growth never alarmed"
+        det = LeakDetector(min_growth=10, sweeps=3)
+        saw = [det.observe(c) for c in (0, 30, 5, 30, 5, 30, 5, 30)]
+        assert not any(saw), "healthy saw-tooth false-tripped"
+
+
+# -------------------------------------------------------------- burns ----
+
+def _with_cap(cap):
+    prev = os.environ.get("ACCORD_RESIDENT_CMDS")
+    os.environ["ACCORD_RESIDENT_CMDS"] = str(cap)
+
+    def restore():
+        if prev is None:
+            os.environ.pop("ACCORD_RESIDENT_CMDS", None)
+        else:
+            os.environ["ACCORD_RESIDENT_CMDS"] = prev
+    return restore
+
+
+class TestPagingBurns:
+    def test_differential_burn_paging_on_off_bit_identical(self):
+        """The SAME burn seed with paging on vs off: every replica's final
+        data-store state and every end-of-run audit round must be
+        bit-identical — paging may move commands between tiers but may
+        not perturb one observable protocol outcome."""
+        from accord_tpu.sim.burn import BurnRun
+
+        def arm():
+            r = BurnRun(23, ops=60, nodes=3, keys=10)
+            stats = r.run()
+            snaps = {n: r.cluster.node(n).data_store.snapshot()
+                     for n in r.cluster.nodes}
+            return stats, snaps, r.audit_rounds, r.cluster
+
+        assert "ACCORD_RESIDENT_CMDS" not in os.environ
+        stats_off, snaps_off, audit_off, _ = arm()
+        restore = _with_cap(6)
+        try:
+            stats_on, snaps_on, audit_on, cluster_on = arm()
+        finally:
+            restore()
+        assert (stats_on.acks, stats_on.nacks, stats_on.shed,
+                stats_on.lost) == (stats_off.acks, stats_off.nacks,
+                                   stats_off.shed, stats_off.lost)
+        assert snaps_on == snaps_off, "replica state diverged under paging"
+        assert audit_on == audit_off, "audit digests diverged under paging"
+        from accord_tpu.local.paging import node_paging_stats
+        per_node = [node_paging_stats(cluster_on.node(n))
+                    for n in cluster_on.nodes]
+        assert all(p is not None for p in per_node)
+        assert sum(p["evictions"] for p in per_node) > 0, \
+            "paging arm never actually paged"
+
+    def test_crash_restart_burn_with_paging(self):
+        """The crash-restart nemesis under a resident cap: the killed
+        node replays its WAL into a FRESH incarnation (scratch spill
+        store wiped, residency re-derived) and the burn's verifier,
+        audit checker, and journal validation all still pass."""
+        from accord_tpu.local.paging import node_paging_stats
+        from accord_tpu.sim.burn import BurnRun
+        restore = _with_cap(6)
+        try:
+            r = BurnRun(31, ops=80, nodes=3, keys=10, restarts=1)
+            stats = r.run()
+        finally:
+            restore()
+        assert stats.restarts == 1
+        assert stats.acks > 0 and stats.lost == 0, stats
+        assert r.journal_checked > 0
+        per_node = [node_paging_stats(r.cluster.node(n))
+                    for n in r.cluster.nodes]
+        assert all(p is not None for p in per_node)
+        assert sum(p["evictions"] for p in per_node) > 0

@@ -198,6 +198,22 @@ def test_elasticity_kinds_are_covered():
         assert kind in recorded, f"nothing records {kind}"
 
 
+def test_paging_kinds_are_covered():
+    """The bounded-memory paging tier's forensics hooks must stay on the
+    ring: each eviction to the spill store (`cmd_evict`), each fault back
+    resident (`cmd_fault`) — both stamped with the command's txn id — and
+    each on-disk spill-frame append (`page_spill`).  Pinned as a SET like
+    the journal lifecycle below, so a hook cannot vanish together with
+    its EVENT_KINDS row."""
+    recorded = _recorded_flight_kinds()
+    for kind, prefix in (("cmd_evict", "local"), ("cmd_fault", "local"),
+                         ("page_spill", "journal")):
+        assert kind in EVENT_KINDS, f"{kind} missing from EVENT_KINDS"
+        assert kind in recorded, f"nothing records {kind}"
+        assert any(p.startswith(prefix) for p in recorded[kind]), \
+            (kind, recorded[kind])
+
+
 def test_frame_coalescing_kinds_are_covered():
     """The transport egress buffer's forensics hooks must stay on the
     ring: every message captured into a peer's coalescing buffer

@@ -248,7 +248,39 @@ def _synthesize(gen: _Gen):
         gen.check_status_ok(),
     ]
     out.extend(_synthesize_admin(gen))
+    out.extend(_synthesize_paging(gen))
     return out
+
+
+def _synthesize_paging(gen: _Gen):
+    """The bounded-memory paging tier's spill-store records
+    (messages/paging.py): a SpillFrame is the ONLY copy of an evicted
+    command between eviction and refault, and a FaultIndexCheckpoint is
+    what a reopened spill store seeds its index from — a codec asymmetry
+    in either one silently corrupts refaulted command state."""
+    from accord_tpu.local.status import Durability, SaveStatus
+    from accord_tpu.messages.paging import FaultIndexCheckpoint, SpillFrame
+
+    tid = gen.txn_id()
+    route = gen.route()
+    applied = SpillFrame(
+        tid, SaveStatus.APPLIED, Durability.MAJORITY, route,
+        gen.partial_txn(), gen.ts(), None, gen.ballot(), gen.ballot(),
+        gen.deps(), gen.deps(), gen.writes(tid), gen.list_result(tid))
+    # the sparse arm: an invalidated command carries no txn/deps/outcome
+    invalidated = SpillFrame(
+        gen.txn_id(), SaveStatus.INVALIDATED, Durability.NOT_DURABLE,
+        route, None, None, None, gen.ballot(), gen.ballot(),
+        None, None, None, None)
+    entries = (tid.pack() + (0, gen.token()),
+               gen.txn_id().pack() + (1 + gen.rng.next_int(0, 3),
+                                      4096 + gen.token()))
+    return [
+        applied, invalidated,
+        FaultIndexCheckpoint(entries, 1 + gen.rng.next_int(0, 3),
+                             8192 + gen.token()),
+        FaultIndexCheckpoint((), 0, 0),  # empty-index arm
+    ]
 
 
 def _synthesize_admin(gen: _Gen):
