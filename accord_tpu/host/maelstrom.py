@@ -279,11 +279,19 @@ class MaelstromHost:
             int(spec["epoch"]),
             [(s[0], s[1], tuple(s[2])) for s in spec["shards"]])
         self.node.receive(install, 0, None)
+
+        def ack():
+            # _emit serializes under _emit_lock, so firing from the WAL
+            # flush thread is safe
+            self._emit(client, {"type": "admin_epoch_ok",
+                                "in_reply_to": body.get("msg_id"),
+                                "epoch": self.node.epoch})
+
         if self.wal is not None:
-            self.wal.sync()  # persist-before-ack
-        self._emit(client, {"type": "admin_epoch_ok",
-                            "in_reply_to": body.get("msg_id"),
-                            "epoch": self.node.epoch})
+            # persist-before-ack without parking the scheduler loop
+            self.wal.sync_soon(ack)
+        else:
+            ack()
 
     def _handle_admin_drain(self, client: str, body: dict) -> None:
         """`{"type":"admin_drain"}`: scale-in this node (the TCP host's
@@ -307,11 +315,18 @@ class MaelstromHost:
             node.receive(DrainDone(node.id), 0, None)
             for to in members:
                 node.send(to, DrainDone(node.id))
+
+            def ack():
+                # every acked write is on disk before we go; _emit holds
+                # _emit_lock so the flush thread may fire this directly
+                self._emit(client, {"type": "admin_drain_ok",
+                                    "in_reply_to": msg_id, "node": node.id,
+                                    "durable": failure is None})
+
             if self.wal is not None:
-                self.wal.sync()  # every acked write is on disk before we go
-            self._emit(client, {"type": "admin_drain_ok",
-                                "in_reply_to": msg_id, "node": node.id,
-                                "durable": failure is None})
+                self.wal.sync_soon(ack)
+            else:
+                ack()
 
         def durability_barrier():
             owned = topology.ranges_for_node(node.id)

@@ -1030,10 +1030,21 @@ class TcpHost:
             [(s[0], s[1], tuple(s[2])) for s in spec["shards"]],
             peers=[tuple(p) for p in peers] if peers else None)
         self.node.receive(install, 0, None)
+
+        def ack():
+            # emit marshals back to the loop, so firing from the WAL
+            # flush thread is safe
+            self.emit(from_id, {"type": "epoch_ok", "req": body.get("req"),
+                                "node": self.my_id,
+                                "epoch": self.node.epoch})
+
         if self.wal is not None:
-            self.wal.sync()  # persist-before-ack: the install survives us
-        self.emit(from_id, {"type": "epoch_ok", "req": body.get("req"),
-                            "node": self.my_id, "epoch": self.node.epoch})
+            # persist-before-ack: the install survives us.  sync_soon
+            # keeps the loop thread free while the flush thread works —
+            # a blocking wal.sync() here stalls every peer connection.
+            self.wal.sync_soon(ack)
+        else:
+            ack()
 
     def _admin_drain(self, from_id: int, body: dict) -> None:
         """`{"type":"drain"}`: scale-in this node.  DrainBegin fences new
@@ -1055,11 +1066,18 @@ class TcpHost:
             node.receive(DrainDone(self.my_id), 0, None)
             for to in members:
                 node.send(to, DrainDone(self.my_id))
+
+            def ack():
+                # every acked write is on disk before we go; emit
+                # marshals to the loop so the flush thread may fire this
+                self.emit(from_id, {"type": "drain_ok", "req": req,
+                                    "node": self.my_id,
+                                    "durable": failure is None})
+
             if self.wal is not None:
-                self.wal.sync()  # every acked write is on disk before we go
-            self.emit(from_id, {"type": "drain_ok", "req": req,
-                                "node": self.my_id,
-                                "durable": failure is None})
+                self.wal.sync_soon(ack)
+            else:
+                ack()
 
         def durability_barrier():
             owned = topology.ranges_for_node(self.my_id)
@@ -1507,7 +1525,11 @@ class TcpClusterClient:
         survives; restart_node brings it back from the WAL)."""
         self.procs[node_id - 1].kill()
         self.procs[node_id - 1].wait(timeout=10.0)
-        sock = self._out.pop(node_id, None)
+        # _out is shared with pacer/reshard-driver threads calling _send:
+        # drop the lane under the same lock or a concurrent submit can
+        # resurrect the dead socket mid-close
+        with self._send_lock:
+            sock = self._out.pop(node_id, None)
         if sock is not None:
             try:
                 sock.close()
@@ -1547,7 +1569,10 @@ class TcpClusterClient:
             self.server.close()
         except OSError:
             pass
-        for s in self._out.values():
+        with self._send_lock:
+            socks = list(self._out.values())
+            self._out.clear()
+        for s in socks:
             try:
                 s.close()
             except OSError:

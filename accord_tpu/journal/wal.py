@@ -380,6 +380,19 @@ class WriteAheadLog:
             self._work.notify()
         return self.wait_durable(seq, timeout_s)
 
+    def sync_soon(self, fn) -> None:
+        """Non-blocking persist-before-ack: run `fn` once everything
+        appended so far is durable.  Unlike `sync()` this never parks the
+        calling thread — safe on the event loop.  `fn` runs inline when
+        already durable, else from the flush thread; callers that touch
+        loop state must marshal back themselves (host `emit` paths do)."""
+        with self._lock:
+            seq = self._seq
+        if self.config.group_commit:
+            with self._work:
+                self._work.notify()
+        self.on_durable(seq, fn)
+
     def close(self) -> None:
         self.sync()
         with self._lock:

@@ -553,7 +553,7 @@ class BurnRun:
         all_tokens = set()
         for node in cluster.nodes.values():
             all_tokens.update(node.data_store.snapshot().keys())
-        for token in all_tokens:
+        for token in sorted(all_tokens):
             histories = [node.data_store.get(Key(token))
                          for node in cluster.nodes.values()]
             longest = max(histories, key=len)

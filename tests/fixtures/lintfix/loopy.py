@@ -1,0 +1,17 @@
+"""Seeded blocking-pass violation: a sleep two hops below the loop."""
+import time
+
+
+class Loop:
+    def _run(self):
+        while True:
+            self._dispatch()
+
+    def _dispatch(self):
+        self._handle()
+
+    def _handle(self):
+        self._slow_path()
+
+    def _slow_path(self):
+        time.sleep(0.1)  # the violation the test pins by file:line

@@ -1,0 +1,5 @@
+from ..verbs import WireVerb
+
+
+class Ping:
+    type = WireVerb.PING_REQ

@@ -1,0 +1,21 @@
+"""Seeded threads-pass violations: two worker threads share unlocked
+state; one attribute is locked in one writer only."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.n = 0
+        self.m = 0
+        self.t1 = threading.Thread(target=self._worker_a)
+        self.t2 = threading.Thread(target=self._worker_b)
+
+    def _worker_a(self):
+        self.n += 1          # unlocked-write (raced by _worker_b)
+        with self.lock:
+            self.m += 1      # locked
+
+    def _worker_b(self):
+        self.n += 1          # unlocked-write
+        self.m += 1          # inconsistent-lock: locked in _worker_a

@@ -1,0 +1,22 @@
+"""Seeded determinism-pass violations (one per code) plus a laundered
+set-iteration negative that must NOT fire."""
+import os
+import random
+import time
+
+
+def decide(xs):
+    t = time.monotonic()            # wall-clock
+    k = random.random()             # global-random
+    key = id(xs)                    # id-keyed
+    mode = os.environ.get("MODE")   # env-read
+    chosen = set(xs)
+    picked = []
+    for x in chosen:                # set-iteration
+        picked.append(x)
+    total = sum(x for x in chosen)  # laundered by sum(): not a finding
+    return t, k, key, mode, picked, total
+
+
+def tuning_from_env():
+    return os.environ.get("TUNING")  # config load: exempt

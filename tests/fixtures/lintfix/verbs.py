@@ -1,0 +1,9 @@
+"""Seeded surface-pass violation: LOST_MSG is registered but no message
+class under messages/ claims it."""
+import enum
+
+
+class WireVerb(enum.Enum):
+    PING_REQ = 1
+    LOST_MSG = 2
+    PONG_RSP = 3  # not _REQ/_MSG: replies correlate by id, never flagged

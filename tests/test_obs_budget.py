@@ -13,7 +13,6 @@ Two hard promises from the obs/ package docstring:
      realistically deep per-key histories).
 """
 
-import ast
 import os
 import time
 
@@ -23,29 +22,19 @@ OBS_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "accord_tpu", "obs")
 
 
-def _imports_of(path):
-    tree = ast.parse(open(path).read(), filename=path)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                yield alias.name
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            yield node.module
-
-
 def test_obs_package_has_no_jax_dependency():
-    files = [f for f in os.listdir(OBS_DIR) if f.endswith(".py")]
-    assert files, "obs package missing?"
-    allowed_internal = ("accord_tpu.obs",)  # intra-package only
-    for f in files:
-        for mod in _imports_of(os.path.join(OBS_DIR, f)):
-            root = mod.split(".")[0]
-            assert root not in ("jax", "jaxlib", "numpy"), \
-                f"{f} imports {mod}: obs/ must stay off the device path"
-            if root == "accord_tpu":
-                assert mod.startswith(allowed_internal), \
-                    (f"{f} imports {mod}: obs/ may only import within "
-                     f"itself (anything else risks pulling jax in)")
+    """Thin wrapper over the analysis suite's layering pass, which owns
+    the AST walk: no jax/jaxlib/numpy under obs/, and its only intra-repo
+    imports are accord_tpu.obs.* (anything else risks pulling jax in)."""
+    from accord_tpu.analysis import layering
+    from accord_tpu.analysis.core import build_package_index
+
+    index = build_package_index()
+    assert any(m.startswith("accord_tpu.obs")
+               for m in index.modules), "obs package missing?"
+    bad = [f for f in layering.run(index) if f.file.startswith(
+        os.path.join("accord_tpu", "obs"))]
+    assert not bad, [f.render() for f in bad]
 
 
 def test_obs_import_does_not_require_jax():

@@ -15,141 +15,57 @@ layer:
      drift from the code.
 """
 
-import ast
 import os
 
+from accord_tpu.analysis import surface
+from accord_tpu.analysis.core import build_package_index
 from accord_tpu.messages.base import MessageType
 from accord_tpu.obs.flight import EVENT_KINDS
 
 ROOT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "accord_tpu")
-MESSAGES_DIR = os.path.join(ROOT, "messages")
+
+# the AST walks these tests used to carry live in the analysis suite
+# now (accord_tpu/analysis/surface.py) — these are thin wrappers so the
+# per-subsystem SET pins below keep their original shape.
+COLLAPSED_VERBS = surface.COLLAPSED_VERBS
+
+_INDEX = None
 
 
-def _parse(path):
-    with open(path) as f:
-        return ast.parse(f.read(), filename=path)
-
-
-def _py_files(root):
-    for dirpath, _dirs, files in os.walk(root):
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(dirpath, f)
-
-
-# the port deliberately applies every Propagate tier through ONE local
-# request class typed PROPAGATE_OTHER_MSG (messages/propagate.py); the
-# per-tier verbs stay in the registry for reference parity but are never
-# emitted.  Any OTHER unclaimed verb is a lint failure.
-COLLAPSED_VERBS = frozenset({
-    "PROPAGATE_PRE_ACCEPT_MSG", "PROPAGATE_STABLE_MSG",
-    "PROPAGATE_APPLY_MSG",
-})
+def _index():
+    global _INDEX
+    if _INDEX is None:
+        _INDEX = build_package_index()
+    return _INDEX
 
 
 def _claimed_verbs():
-    """{verb_name: [files]} for every assignment whose value references
-    `MessageType.X` under messages/ — covering plain `type = ...` class
-    attributes, Kind-map attributes (commit.py's COMMIT_SLOW_PATH = ...),
-    and dynamic `self.type = ...` picks (apply_msg.py)."""
-    claimed = {}
-    for path in _py_files(MESSAGES_DIR):
-        if os.path.basename(path) == "base.py":
-            continue  # the registry itself
-        for node in ast.walk(_parse(path)):
-            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
-                continue
-            values = [node.value] if node.value is not None else []
-            for v in values:
-                if isinstance(v, ast.Attribute) \
-                        and isinstance(v.value, ast.Name) \
-                        and v.value.id == "MessageType":
-                    claimed.setdefault(v.attr, []).append(
-                        os.path.basename(path))
-    return claimed
+    return surface.claimed_verbs(_index())
+
+
+def _recorded_flight_kinds():
+    return surface.recorded_flight_kinds(_index())
 
 
 def test_every_registered_request_verb_is_claimed_by_a_message_class():
-    claimed = _claimed_verbs()
-    missing = []
-    for mt in MessageType:
-        if not mt.name.endswith("_REQ") and not mt.name.endswith("_MSG"):
-            continue  # replies are correlated via msg ids, not dispatched
-        if mt.name not in claimed and mt.name not in COLLAPSED_VERBS:
-            missing.append(mt.name)
-    assert not missing, (
-        f"verbs registered in MessageType but claimed by no message class "
-        f"in messages/ — they can never be processed (or traced as "
-        f"rx:<VERB>): {missing}")
-    # unknown claims would be caught at import, but assert symmetrically
-    unknown = [v for v in claimed if v not in MessageType.__members__]
-    assert not unknown, unknown
-    # the collapse allowlist must not rot into covering real gaps
-    stale_allowlist = [v for v in COLLAPSED_VERBS if v in claimed]
-    assert not stale_allowlist, (
-        f"verbs in COLLAPSED_VERBS are now claimed — drop them from the "
-        f"allowlist: {stale_allowlist}")
+    bad = surface.verb_findings(_index(), [m.name for m in MessageType])
+    assert not bad, [f.render() for f in bad]
 
 
 def test_rx_span_instrumentation_covers_every_verb():
     """`rx:<VERB>` span events and flight rx records are generated
-    GENERICALLY from request.type in Node._process — assert those calls
-    exist (with the verb argument derived from the message type), so every
-    claimed verb above is covered by construction."""
-    node_py = _parse(os.path.join(ROOT, "local", "node.py"))
-    process = next(n for n in ast.walk(node_py)
-                   if isinstance(n, ast.FunctionDef)
-                   and n.name == "_process")
-    calls = {}
-    for n in ast.walk(process):
-        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
-            calls.setdefault(n.func.attr, []).append(n)
-    assert "rx" in calls, "Node._process lost the obs.rx span event"
-    flight_records = [c for c in calls.get("record", [])
-                      if c.args and isinstance(c.args[0], ast.Constant)
-                      and c.args[0].value == "rx"]
-    assert flight_records, "Node._process lost the flight 'rx' record"
-    # and the send side stamps tx events for the same generic verb
-    send = next(n for n in ast.walk(node_py)
-                if isinstance(n, ast.FunctionDef) and n.name == "send")
-    tx = [n for n in ast.walk(send)
-          if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
-          and n.func.attr == "record" and n.args
-          and isinstance(n.args[0], ast.Constant) and n.args[0].value == "tx"]
-    assert tx, "Node.send lost the flight 'tx' record"
-
-
-def _recorded_flight_kinds():
-    """Every literal kind passed to a `.record("<kind>", ...)` call under
-    accord_tpu/ (the flight recorder is the only API named `record` taking
-    a leading string literal)."""
-    kinds = {}
-    for path in _py_files(ROOT):
-        for n in ast.walk(_parse(path)):
-            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
-                    and n.func.attr == "record" and n.args \
-                    and isinstance(n.args[0], ast.Constant) \
-                    and isinstance(n.args[0].value, str):
-                kinds.setdefault(n.args[0].value, []).append(
-                    os.path.relpath(path, ROOT))
-    return kinds
+    GENERICALLY from request.type in Node._process — the surface pass
+    asserts those calls exist (with the verb argument derived from the
+    message type), so every claimed verb above is covered by
+    construction."""
+    bad = surface.instrumentation_findings(_index())
+    assert not bad, [f.render() for f in bad]
 
 
 def test_every_flight_event_kind_is_documented():
-    recorded = _recorded_flight_kinds()
-    undocumented = {k: v for k, v in recorded.items()
-                    if k not in EVENT_KINDS}
-    assert not undocumented, (
-        f"flight event kinds recorded but not documented in "
-        f"obs.flight.EVENT_KINDS: {undocumented}")
-    dead = [k for k in EVENT_KINDS if k not in recorded]
-    assert not dead, (
-        f"EVENT_KINDS documents kinds nothing records: {dead}")
-    # each documented kind carries a non-trivial description naming its
-    # emitting layer
-    for kind, desc in EVENT_KINDS.items():
-        assert len(desc) > 20 and "/" in desc, (kind, desc)
+    bad = surface.flight_findings(_index(), EVENT_KINDS)
+    assert not bad, [f.render() for f in bad]
 
 
 def test_infer_ladder_kinds_are_covered():
