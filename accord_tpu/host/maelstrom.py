@@ -218,12 +218,27 @@ class MaelstromHost:
         # it is acked (group-commit fsync windows; see journal/wal.py)
         from accord_tpu.journal import attach_journal_from_env
         self.wal = attach_journal_from_env(self.node)
+        # ACCORD_QOS=1: per-tenant QoS admission tier (same layer the TCP
+        # host wires; see accord_tpu/qos/).  Default off — with the gate
+        # unset the lag observer and txn path are the pre-QoS wiring.
+        from accord_tpu.qos import qos_tier_from_env
+        self.qos = qos_tier_from_env(
+            self.node.obs.registry, self.node.obs.flight,
+            clock_us=lambda: int(time.time() * 1e6),
+            loop_health=self.loop_health, wal=self.wal)
+        if self.qos is not None:
+            lh_hook, qos_hook = self.loop_health.timer_lag, self.qos.observe_lag
+
+            def _lag_chain(lag_s, _lh=lh_hook, _qos=qos_hook):
+                _lh(lag_s)
+                _qos(lag_s)
+            self.scheduler.lag_observer = _lag_chain
         # ACCORD_PIPELINE=1: continuous micro-batching ingest (same layer
         # the TCP host wires; see accord_tpu/pipeline/).  Default off.
         from accord_tpu.pipeline import (Pipeline, PipelineConfig,
                                          pipeline_enabled)
         self.pipeline = Pipeline(self.node, self.scheduler,
-                                 PipelineConfig.from_env()) \
+                                 PipelineConfig.from_env(), qos=self.qos) \
             if pipeline_enabled() else None
         # ACCORD_METRICS_PORT=<base>: per-process Prometheus/JSON metrics
         # endpoint (base + node_id - 1), same layer the TCP host exposes
@@ -359,6 +374,19 @@ class MaelstromHost:
                                 "code": 11, "text": "draining",
                                 "drained": True})
             return
+        if self.qos is not None:
+            # QoS outer ring: admission before any coordination/journal
+            # state is spent.  Maelstrom code 11 is temporarily-unavailable
+            # (retriable); the tenant defaults to the client name so every
+            # Maelstrom client gets its own token bucket
+            nack = self.qos.admit(str(body.get("tenant") or client),
+                                  str(body.get("priority") or "normal"))
+            if nack is not None:
+                self._emit(client, {"type": "error", "in_reply_to": msg_id,
+                                    "code": 11, "text": repr(nack),
+                                    "qos": True, "reason": nack.reason,
+                                    "retry_after_us": nack.retry_after_us})
+                return
         reads = []
         appends: Dict[Key, int] = {}
         for op, k, v in ops:
@@ -370,12 +398,16 @@ class MaelstromHost:
                     # the list-register data plane carries one append per
                     # key per txn; acking a collapsed second append would be
                     # a lost acknowledged write
+                    if self.qos is not None:
+                        self.qos.op_done()  # admitted but never coordinated
                     self._emit(client, {"type": "error",
                                         "in_reply_to": msg_id, "code": 10,
                                         "text": f"duplicate append to {k}"})
                     return
                 appends[Key(token)] = v
             else:
+                if self.qos is not None:
+                    self.qos.op_done()  # admitted but never coordinated
                 self._emit(client, {"type": "error", "in_reply_to": msg_id,
                                     "code": 10,
                                     "text": f"unsupported op {op}"})
@@ -387,6 +419,10 @@ class MaelstromHost:
                   update=ListUpdate(appends) if appends else None)
 
         def done(result, failure):
+            if self.qos is not None:
+                # admitted op settled (either way): shrink the tier's
+                # inflight backlog signal
+                self.qos.op_done()
             if failure is not None:
                 self._emit(client, {"type": "error", "in_reply_to": msg_id,
                                     "code": 11, "text": repr(failure)})

@@ -14,11 +14,12 @@ from __future__ import annotations
 
 import json
 import queue
+import random
 import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from accord_tpu.host.maelstrom import key_token
 from accord_tpu.sim.verify import Observation, StrictSerializabilityVerifier
@@ -98,6 +99,14 @@ class MaelstromRunner:
         self.pending: Dict[int, dict] = {}   # msg_id -> op record
         self.results: List[dict] = []
         self.init_acks: set = set()
+        # QoS-nack honor (qos/): a code-11 error carrying retry_after_us is
+        # resubmitted after the hinted backoff (with jitter) instead of
+        # being finalized — up to qos_max_retries attempts per op
+        self.qos_max_retries = 3
+        self.qos_nacks = 0
+        self.qos_retries = 0
+        self._retryq: List[Tuple[int, dict]] = []  # (due_us, op record)
+        self._retry_rng = random.Random(seed ^ 0x51C)
         # appended values must be unique across the runner's LIFETIME, not
         # per workload call: a crash-restart harness runs several phases
         # against the same cluster and verifies them together
@@ -115,12 +124,50 @@ class MaelstromRunner:
         elif dest.startswith("c"):
             rec = self.pending.pop(body.get("in_reply_to"), None)
             if rec is not None:
+                if body.get("qos") and body.get("retry_after_us") is not None \
+                        and rec.get("attempt", 0) < self.qos_max_retries:
+                    self.qos_nacks += 1
+                    attempt = rec.get("attempt", 0) + 1
+                    rec["attempt"] = attempt
+                    base = min(2_000_000, int(body["retry_after_us"])
+                               * (2 ** (attempt - 1)))
+                    delay = base + int(self._retry_rng.random() * 0.5 * base)
+                    self._retryq.append(
+                        (int(time.monotonic() * 1e6) + delay, rec))
+                    return
                 rec["reply"] = body
                 rec["end_us"] = int(time.monotonic() * 1e6)
                 self.results.append(rec)
 
+    def _flush_retries(self) -> None:
+        """Resubmit QoS-nacked ops whose (jittered) retry_after elapsed,
+        under fresh msg_ids; `start_us` is kept from the FIRST attempt so
+        latency accounting includes the honored backoff."""
+        if not self._retryq:
+            return
+        now = int(time.monotonic() * 1e6)
+        due = [item for item in self._retryq if item[0] <= now]
+        if not due:
+            return
+        self._retryq = [item for item in self._retryq if item[0] > now]
+        for _, rec in due:
+            self.qos_retries += 1
+            self._msg_seq += 1
+            msg_id = self._msg_seq
+            rec["msg_id"] = msg_id
+            self.pending[msg_id] = rec
+            dest = self.names[msg_id % len(self.names)]
+            body = {"type": "txn", "msg_id": msg_id, "txn": rec["ops"]}
+            if rec.get("tenant"):
+                body["tenant"] = rec["tenant"]
+            if rec.get("priority"):
+                body["priority"] = rec["priority"]
+            self.procs[dest].send({"src": rec["client"], "dest": dest,
+                                   "body": body})
+
     def pump(self, timeout: float = 0.05) -> int:
         handled = 0
+        self._flush_retries()
         try:
             name, line = self.inbox.get(timeout=timeout)
         except queue.Empty:
@@ -240,18 +287,22 @@ class MaelstromRunner:
             30.0 + 15.0 * len(self.names))
         assert ok, f"init timed out: {sorted(self.init_acks)}"
 
-    def submit_txn(self, client: str, ops: list, to: Optional[str] = None
-                   ) -> int:
+    def submit_txn(self, client: str, ops: list, to: Optional[str] = None,
+                   tenant: str = "", priority: str = "") -> int:
         self._msg_seq += 1
         msg_id = self._msg_seq
         dest = to if to is not None else \
             self.names[msg_id % len(self.names)]
         self.pending[msg_id] = {
             "msg_id": msg_id, "client": client, "ops": ops,
+            "tenant": tenant, "priority": priority,
             "start_us": int(time.monotonic() * 1e6), "reply": None}
-        self.procs[dest].send({"src": client, "dest": dest,
-                               "body": {"type": "txn", "msg_id": msg_id,
-                                        "txn": ops}})
+        body = {"type": "txn", "msg_id": msg_id, "txn": ops}
+        if tenant:
+            body["tenant"] = tenant
+        if priority:
+            body["priority"] = priority
+        self.procs[dest].send({"src": client, "dest": dest, "body": body})
         return msg_id
 
     # ------------------------------------------------------------ workload --
@@ -303,9 +354,10 @@ class MaelstromRunner:
         """Read every key through an ordinary linearizable read txn."""
         # drain in-flight txns first: a straggler acked after the final-read
         # snapshot would be verified against a state that predates it
-        self.pump_until(lambda: not self.pending, 30.0)
+        self.pump_until(lambda: not self.pending and not self._retryq, 30.0)
         for msg_id in list(self.pending):
             del self.pending[msg_id]  # never acked; late replies are ignored
+        self._retryq.clear()  # a queued retry must not land past the snapshot
         ops = [["r", k, None] for k in range(n_keys)]
         msg_id = self.submit_txn("c9", ops, to=self.names[0])
         assert self.pump_until(

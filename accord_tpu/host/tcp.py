@@ -56,6 +56,10 @@ from accord_tpu.utils.random_source import RandomSource
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 256 << 20  # corrupt-length guard: drop the connection instead
 _RECV_CHUNK = 1 << 18
+# max bulk-tier client submits dispatched per loop pass under QoS: bounds
+# pass length during an overload flood so high submits, protocol messages
+# and timers keep a few-ms service cadence (see _run_loop's lane comment)
+_BULK_PER_PASS = 32
 
 
 def _build_list_txn(read_tokens, appends: Dict[int, int],
@@ -538,6 +542,7 @@ class TcpHost:
         self.selector = selectors.DefaultSelector()
         self._calls: deque = deque()     # cross-thread entry (thread-safe)
         self._local_q: deque = deque()   # self-addressed bodies (loop only)
+        self._bulk_backlog: deque = deque()  # deferred bulk-tier submits
         self._dirty: List[_PeerLane] = []  # lanes with an open flush tick
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
@@ -603,6 +608,25 @@ class TcpHost:
         from accord_tpu.journal import attach_journal_from_env
         self.wal = attach_journal_from_env(self.node)
 
+        # ACCORD_QOS=1: per-tenant QoS admission tier (qos/) — pressure-
+        # adaptive shed before any journal/coordination state is spent,
+        # fed by the loop-health lag signal (the lag observer chains: both
+        # callbacks run on the loop thread) and the WAL's group-commit
+        # backlog.  Default off: with the gate unset the lag observer and
+        # submit path are byte-for-byte the pre-QoS wiring.
+        from accord_tpu.qos import qos_tier_from_env
+        self.qos = qos_tier_from_env(
+            self.node.obs.registry, self.flight,
+            clock_us=lambda: time.time_ns() // 1000,
+            loop_health=self.loop_health, wal=self.wal)
+        if self.qos is not None:
+            lh_hook, qos_hook = self.loop_health.timer_lag, self.qos.observe_lag
+
+            def _lag_chain(lag_s, _lh=lh_hook, _qos=qos_hook):
+                _lh(lag_s)
+                _qos(lag_s)
+            self.scheduler.lag_observer = _lag_chain
+
         # ACCORD_PIPELINE=1: continuous micro-batching ingest — client
         # submissions coalesce into deadline-bounded batches whose fan-out
         # leaves as one MultiPreAccept envelope per replica (and whose
@@ -611,7 +635,7 @@ class TcpHost:
         from accord_tpu.pipeline import (Pipeline, PipelineConfig,
                                          pipeline_enabled)
         self.pipeline = Pipeline(self.node, self.scheduler,
-                                 PipelineConfig.from_env()) \
+                                 PipelineConfig.from_env(), qos=self.qos) \
             if pipeline_enabled() else None
 
         # ACCORD_METRICS_PORT=<base>: Prometheus text + JSON snapshot on
@@ -793,6 +817,22 @@ class TcpHost:
         #    under one sink coalescing window (pipeline mode) so
         #    same-destination fan-out amortises
         items: List = []
+        # QoS priority lane: within one select pass's burst, bulk-tier
+        # client submits are dispatched AFTER everything else — protocol
+        # messages (they advance already-admitted txns, including the
+        # high class's rounds) and high-class submits must not queue
+        # behind an overload flood's decode+nack work.  Order within
+        # each lane is preserved; with QoS off the single FIFO is
+        # untouched.
+        bulk: List = []
+
+        def _enqueue(src: int, body: dict) -> None:
+            if (self.qos is not None and body.get("type") == "submit"
+                    and body.get("priority") != "high"):
+                bulk.append(lambda s=src, b=body: self._dispatch(s, b))
+            else:
+                items.append(lambda s=src, b=body: self._dispatch(s, b))
+
         for key, mask in events:
             kind, payload = key.data
             if kind == "wake":
@@ -813,15 +853,25 @@ class TcpHost:
                         src = frame.get("src", 0)
                         if "m" in frame:
                             for body in frame["m"]:
-                                items.append(
-                                    lambda s=src, b=body:
-                                    self._dispatch(s, b))
+                                _enqueue(src, body)
                         else:
-                            items.append(
-                                lambda s=src, b=frame.get("body", {}):
-                                self._dispatch(s, b))
+                            _enqueue(src, frame.get("body", {}))
         while self._local_q:
             items.append(self._local_q.popleft())
+        # bounded bulk drain: at most _BULK_PER_PASS bulk submits join
+        # this pass; the rest wait in the loop-owned backlog.  Keeps
+        # every pass short under an overload flood so the selector (and
+        # with it protocol messages, high submits, timers) is serviced
+        # every few milliseconds — a deferred bulk submit is simply
+        # admitted-or-nacked a pass or two later, which its retry_after
+        # already accounts for.  The backlog feeds loop-health's
+        # saturation signal below, so deferral itself raises pressure.
+        if bulk:
+            self._bulk_backlog.extend(bulk)
+        if self._bulk_backlog:
+            take = min(len(self._bulk_backlog), _BULK_PER_PASS)
+            for _ in range(take):
+                items.append(self._bulk_backlog.popleft())
 
         coalesce = self.pipeline is not None and len(items) > 1
         if coalesce:
@@ -843,7 +893,8 @@ class TcpHost:
             # record nothing
             self.loop_health.tick(
                 busy_pre + (time.perf_counter() - t_resume), len(items),
-                len(self._calls) + len(self._local_q))
+                len(self._calls) + len(self._local_q)
+                + len(self._bulk_backlog))
 
     def _flush_all(self) -> None:
         dirty, self._dirty = self._dirty, []
@@ -851,7 +902,7 @@ class TcpHost:
             lane.flush()
 
     def _poll_timeout(self, have_work: bool) -> float:
-        if have_work or self._local_q or self._calls:
+        if have_work or self._local_q or self._calls or self._bulk_backlog:
             return 0.0
         deadline = self.scheduler.next_deadline()
         return 0.2 if deadline is None \
@@ -1110,10 +1161,27 @@ class TcpHost:
                                 "ok": False, "error": "draining",
                                 "shed": True, "drained": True})
             return
+        if self.qos is not None:
+            # QoS outer ring: admission BEFORE journal append/coordination
+            # state is spent — the nack is retriable by construction and
+            # carries the backoff hint the client honors
+            nack = self.qos.admit(str(body.get("tenant") or ""),
+                                  str(body.get("priority") or "normal"))
+            if nack is not None:
+                self.emit(from_id, {"type": "submit_reply", "req": req,
+                                    "ok": False, "error": repr(nack),
+                                    "shed": True, "qos": True,
+                                    "reason": nack.reason,
+                                    "retry_after_us": nack.retry_after_us})
+                return
         want_phases = bool(body.get("phases"))
 
         def done(value, failure):
             from accord_tpu.pipeline.backpressure import Rejected
+            if self.qos is not None:
+                # admitted op settled (either way): shrink the tier's
+                # inflight backlog signal
+                self.qos.op_done()
             reads = {}
             if failure is None and value is not None:
                 reads = {k.token: list(v)
@@ -1292,14 +1360,31 @@ class TcpClusterClient:
             _send_frame(sock, {"src": 0, "body": body})
 
     def submit(self, to: int, reads, appends: Dict[int, int], req,
-               ephemeral: bool = False, want_phases: bool = False) -> None:
+               ephemeral: bool = False, want_phases: bool = False,
+               tenant: str = "", priority: str = "") -> None:
         body = {"type": "submit", "req": req, "reads": list(reads),
                 "appends": {str(k): v for k, v in appends.items()}}
         if ephemeral:
             body["kind"] = "ephemeral"
         if want_phases:
             body["phases"] = True
+        if tenant:
+            body["tenant"] = tenant
+        if priority:
+            body["priority"] = priority
         self._send(to, body)
+
+    def qos_backoff_us(self, reply_body: dict, attempt: int = 1,
+                       rng=None) -> int:
+        """Honor a QoS nack's `retry_after_us` hint with decorrelating
+        jitter: hint * 2^(attempt-1), plus 0..50% extra so a shed burst of
+        clients does not reconverge on the same instant."""
+        base = int(reply_body.get("retry_after_us") or 10_000)
+        base = min(2_000_000, base * (2 ** max(0, attempt - 1)))
+        if rng is None:
+            import random as _random
+            rng = _random
+        return base + int(rng.random() * 0.5 * base)
 
     def recv(self, timeout_s: float = 30.0) -> Optional[dict]:
         import queue

@@ -55,6 +55,9 @@ _MODULES = [
     "accord_tpu.impl.list_store",
     "accord_tpu.coordinate.errors",
     "accord_tpu.pipeline.backpressure",
+    # QosRejected: the admission tier's retriable nack must survive the
+    # wire (retry_after_us/tenant/priority re-attached via wire_extra)
+    "accord_tpu.qos.admission",
     "accord_tpu.utils.interval_map",
 ]
 
@@ -206,7 +209,14 @@ def _encode_slow(obj: Any) -> Any:
     if isinstance(obj, dict):
         return _enc_dict(obj)
     if isinstance(obj, BaseException):
-        return {"$x": type(obj).__name__, "msg": str(obj)}
+        out = {"$x": type(obj).__name__, "msg": str(obj)}
+        # exceptions encode by name+message only; ones carrying
+        # machine-readable payload (QosRejected's retry_after_us hint)
+        # declare it via wire_extra() and get it re-attached on decode
+        extra = getattr(obj, "wire_extra", None)
+        if extra is not None:
+            out["f"] = {k: encode(v) for k, v in extra().items()}
+        return out
     _registry()
     cls = type(obj)
     name = cls.__name__
@@ -285,7 +295,10 @@ def _decode_tagged(data: dict) -> Any:
         _registry()
         cls = _CLASSES.get(data["$x"])
         if cls is not None and issubclass(cls, BaseException):
-            return cls(data["msg"])
+            exc = cls(data["msg"])
+            for key, val in (data.get("f") or {}).items():
+                setattr(exc, key, decode(val))
+            return exc
         return RuntimeError(f"{data['$x']}: {data['msg']}")
     name = data["$c"]
     cls = _registry().get(name)

@@ -203,6 +203,14 @@ class WriteAheadLog:
     def last_seq(self) -> int:
         return self._seq
 
+    def queue_depth(self) -> int:
+        """Group-commit backlog: records appended but not yet handed to the
+        flush batch.  Read lock-free from the QoS pressure controller (loop
+        thread) — `len` of a list is atomic under the GIL and an off-by-a-
+        few stale read only nudges a normalized pressure contribution, so
+        the flush thread's mutations need no coordination here."""
+        return len(self._buffer)
+
     def append(self, request) -> int:
         """Journal one side-effecting request; returns its sequence number.
         Durable once `durable_seq` reaches it (immediately in sync mode)."""

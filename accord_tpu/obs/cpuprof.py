@@ -315,6 +315,12 @@ class LoopHealth:
                 self._last_lag_flight = now
                 self.flight.record("loop_lag", None, (lag_us,))
 
+    @property
+    def saturated(self) -> bool:
+        """Current (edge-triggered, hysteresis-cleared) saturation state —
+        the QoS admission tier reads this as a pressure floor."""
+        return self._saturated
+
     def tick(self, busy_s: float, burst: int, depth: int) -> None:
         """One loop pass that did work: `busy_s` excludes the blocking
         poll, `burst` is the dispatched item count, `depth` the backlog

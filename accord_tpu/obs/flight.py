@@ -119,6 +119,16 @@ EVENT_KINDS = {
     "page_spill": "spill frame appended to the paging tier's on-disk "
                   "store (journal/fault_index.py); data=(segment, offset, "
                   "payload_bytes)",
+    "qos_admit": "submit admitted by the QoS tier (qos/admission.py; "
+                 "sampled 1-in-64 so a healthy host does not wash out its "
+                 "own ring); data=(tenant, priority, admitted_since_last)",
+    "qos_shed": "submit shed by the QoS tier — pressure above the class "
+                "threshold, or the pipeline's last-resort inner ring "
+                "(qos/admission.py); data=(tenant, priority, reason, "
+                "millipressure)",
+    "qos_throttle": "submit throttled by the QoS tier — tenant token "
+                    "bucket empty (qos/admission.py); data=(tenant, "
+                    "priority, retry_after_us)",
 }
 
 

@@ -251,6 +251,42 @@ def loop_section(metrics: dict) -> dict:
     }
 
 
+def _qos_section(metrics: dict) -> dict:
+    """QoS admission-tier report (qos/): cluster totals, per-priority
+    split (the fairness surface: shed{high} must stay 0 while best_effort
+    is being admitted), and per-tenant admitted-goodput/shed-rate."""
+    submitted = _counter_total(metrics, "accord_qos_submitted_total")
+    inner = _counter_total(metrics, "accord_qos_inner_shed_total")
+    if not submitted and not inner:
+        return {"submitted": 0}
+    by_tenant_sub = _counter_by_label(metrics, "accord_qos_submitted_total",
+                                      "tenant")
+    by_tenant_adm = _counter_by_label(metrics, "accord_qos_admitted_total",
+                                      "tenant")
+    tenants = {}
+    for tenant, sub in sorted(by_tenant_sub.items()):
+        adm = by_tenant_adm.get(tenant, 0)
+        tenants[tenant] = {
+            "submitted": sub, "admitted": adm,
+            "shed_rate": round(1.0 - adm / sub, 4) if sub else 0.0}
+    return {
+        "submitted": submitted,
+        "admitted": _counter_total(metrics, "accord_qos_admitted_total"),
+        "shed": _counter_total(metrics, "accord_qos_shed_total"),
+        "throttled": _counter_total(metrics, "accord_qos_throttled_total"),
+        "inner_shed": inner,
+        "admitted_by_priority": _counter_by_label(
+            metrics, "accord_qos_admitted_total", "priority"),
+        "shed_by_priority": _counter_by_label(
+            metrics, "accord_qos_shed_total", "priority"),
+        "throttled_by_priority": _counter_by_label(
+            metrics, "accord_qos_throttled_total", "priority"),
+        "tenants": tenants,
+        "pressure_milli_max": _gauge_max(metrics,
+                                         "accord_qos_pressure_milli"),
+    }
+
+
 def summarize(metrics: dict, cpu: Optional[dict] = None) -> dict:
     paths = _counter_by_label(metrics, "accord_path_total", "path")
     fast = paths.get("fast", 0)
@@ -304,6 +340,7 @@ def summarize(metrics: dict, cpu: Optional[dict] = None) -> dict:
             "queue_wait_us": _hist_report(_merged_hist(
                 metrics, "accord_pipeline_queue_wait_us")),
         },
+        "qos": _qos_section(metrics),
         "transport": {
             # per-peer frame coalescing at the TCP egress buffer
             # (host/tcp.py): how many protocol messages each wire frame

@@ -38,7 +38,7 @@ class Pipeline:
     """Facade wiring IngestQueue -> BatchCoordinator for one node."""
 
     def __init__(self, node, scheduler=None,
-                 config: Optional[PipelineConfig] = None):
+                 config: Optional[PipelineConfig] = None, qos=None):
         self.node = node
         self.config = config if config is not None else PipelineConfig()
         # per-stage counters live in the node's metrics registry (obs/)
@@ -49,7 +49,8 @@ class Pipeline:
             scheduler if scheduler is not None else node.scheduler,
             self.batcher.coordinate_batch, self.config, self.stats,
             trace=node.trace,
-            flight=getattr(getattr(node, "obs", None), "flight", None))
+            flight=getattr(getattr(node, "obs", None), "flight", None),
+            qos=qos)
 
     def submit(self, txn):
         """Admit one client transaction; returns its AsyncResult (settled

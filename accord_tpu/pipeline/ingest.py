@@ -85,7 +85,7 @@ class IngestQueue:
 
     def __init__(self, scheduler, dispatch: Callable, config: PipelineConfig,
                  stats: Optional[PipelineStats] = None,
-                 trace=None, flight=None):
+                 trace=None, flight=None, qos=None):
         from accord_tpu.utils.tracing import NO_TRACE
         self.scheduler = scheduler
         self.dispatch = dispatch
@@ -97,6 +97,10 @@ class IngestQueue:
         # on the forensics ring so a shedding node's timeline explains a
         # client's Rejected.  None on bare queues (unit tests).
         self.flight = flight
+        # the host's QoS tier (qos/admission.py), when enabled: this queue
+        # is its LAST-RESORT inner ring, so its sheds are tallied there too
+        # and the exported accounting covers every rejection path
+        self.qos = qos
         self._q: Deque[Admitted] = deque()
         self._timer = None
         self._deadline_us: Optional[int] = None
@@ -117,6 +121,8 @@ class IngestQueue:
                 self.trace.event("pipeline_shed", depth=len(self._q))
             if self.flight is not None:
                 self.flight.record("pipeline_shed", None, (len(self._q),))
+            if self.qos is not None:
+                self.qos.note_inner_shed(len(self._q))
             result.try_failure(Rejected(
                 f"ingest queue full ({self.config.max_queue}); retry later"))
             return result

@@ -78,6 +78,8 @@ class BurnRun:
                  trace: bool = False,
                  pipeline: bool = False,
                  pipeline_config=None,
+                 qos: bool = False,
+                 qos_config=None,
                  restarts: int = 0,
                  journal_dir: Optional[str] = None,
                  restart_down_s: float = 2.0,
@@ -114,7 +116,15 @@ class BurnRun:
             store_factory=store_factory, clock_drift=clock_drift,
             journal_dir=journal_dir,
             trace=trace, pipeline=pipeline,
-            pipeline_config=pipeline_config)
+            pipeline_config=pipeline_config,
+            qos=qos, qos_config=qos_config)
+        # QoS arm: ops carry a randomized tenant (t0..t2) and priority
+        # class; per-class outcomes are tallied CLIENT-side (exact across
+        # crash-restarts, which reset a node's registry counters) so the
+        # fairness invariant — high is never QoS-shed while best_effort is
+        # being admitted — is assertable from the run alone
+        self.qos = qos
+        self.qos_class_stats: Dict[str, Dict[str, int]] = {}
         if drop_prob > 0:
             self.cluster.network.default_link = LinkConfig(
                 deliver_prob=1.0 - drop_prob)
@@ -341,13 +351,35 @@ class BurnRun:
             txn = self._gen_txn()
             # clients only reach live nodes (a killed node's socket is gone)
             origin = self.rng.pick(cluster.live_node_ids())
+            tenant = priority = ""
+            if self.qos:
+                tenant = f"t{self.rng.next_int(3)}"
+                roll = self.rng.next_float()
+                priority = ("high" if roll < 0.2
+                            else "normal" if roll < 0.7 else "best_effort")
             start_us = cluster.queue.clock.now_us
-            result = cluster.pipeline_submit(origin, txn)
+            result = cluster.pipeline_submit(origin, txn, tenant, priority)
 
             def done(value, failure):
                 from accord_tpu.pipeline.backpressure import Rejected
+                from accord_tpu.qos import QosRejected
                 inflight[0] -= 1
                 end_us = cluster.queue.clock.now_us
+                if priority:
+                    cs = self.qos_class_stats.setdefault(
+                        priority, {"acked": 0, "qos_shed": 0,
+                                   "qos_throttle": 0, "inner_shed": 0,
+                                   "failed": 0, "lost": 0})
+                    if isinstance(failure, QosRejected):
+                        cs["qos_" + failure.reason] += 1
+                    elif isinstance(failure, Rejected):
+                        cs["inner_shed"] += 1
+                    elif failure is not None:
+                        cs["failed"] += 1
+                    elif isinstance(value, ListResult):
+                        cs["acked"] += 1
+                    else:
+                        cs["lost"] += 1
                 if isinstance(failure, Rejected):
                     # admission shed: its own summary tally (the txn was
                     # never coordinated — folding it into nacks hid every
@@ -617,6 +649,11 @@ def main(argv=None) -> int:
     parser.add_argument("--pipeline", action="store_true",
                         help="submit through the continuous micro-batching "
                              "ingest pipeline (accord_tpu/pipeline/)")
+    parser.add_argument("--qos", action="store_true",
+                        help="submit through the per-tenant QoS admission "
+                             "tier (accord_tpu/qos/): randomized tenants + "
+                             "priority classes, deterministic pressure "
+                             "shedding under virtual time")
     parser.add_argument("--range-heavy", action="store_true",
                         help="range reads ~1 in 3 ops instead of 1 in 8")
     parser.add_argument("--eph-heavy", action="store_true",
@@ -708,6 +745,7 @@ def main(argv=None) -> int:
                       num_command_stores=args.stores,
                       partitions=args.partitions, clock_drift=args.drift,
                       trace=args.trace, pipeline=args.pipeline,
+                      qos=args.qos,
                       restarts=args.restart, journal_dir=journal_dir,
                       restart_down_s=args.down,
                       eph_ratio=0.5 if args.eph_heavy else 0.0,
@@ -759,6 +797,14 @@ def main(argv=None) -> int:
                       f"batch_max={max(s.batch_size_max for s in ps)} "
                       f"batch_mean="
                       f"{sum(s.dispatched for s in ps) / max(1, sum(s.batches for s in ps)):.1f}]")
+        if run.qos_class_stats:
+            parts = []
+            for pr in ("high", "normal", "best_effort"):
+                cs = run.qos_class_stats.get(pr)
+                if cs:
+                    parts.append(f"{pr}={cs['acked']}a/{cs['qos_shed']}s/"
+                                 f"{cs['qos_throttle']}t/{cs['inner_shed']}i")
+            extra += " qos[" + " ".join(parts) + "]"
         inf = {"evidence": 0, "quorum_evidence": 0, "inferred_rounds": 0,
                "no_round_commits": 0, "fence_refusals": 0,
                "safe_to_clean": 0}

@@ -106,7 +106,7 @@ class SimCluster:
                  clock_drift: bool = False, journal: bool = True,
                  journal_dir: Optional[str] = None,
                  trace: bool = False, pipeline: bool = False,
-                 pipeline_config=None):
+                 pipeline_config=None, qos: bool = False, qos_config=None):
         self.random = RandomSource(seed)
         self.queue = PendingQueue(self.random.fork())
         self.network = SimNetwork(self.queue, self.random.fork())
@@ -159,11 +159,23 @@ class SimCluster:
         self.pipelines: Dict[int, object] = {}
         self._pipeline_enabled = pipeline
         self._pipeline_config = pipeline_config
+        # per-tenant QoS admission tiers (accord_tpu/qos/) on every node,
+        # clocked by virtual time so the deterministic burn can exercise
+        # priority-aware shedding under the full nemesis stack.  Built
+        # BEFORE the pipelines: the ingest queue is the tier's last-resort
+        # inner ring and tallies its sheds there.
+        self.qos_tiers: Dict[int, object] = {}
+        self._qos_enabled = qos
+        self._qos_config = qos_config
+        if qos:
+            for nid in self.nodes:
+                self._build_qos_tier(nid)
         if pipeline:
             from accord_tpu.pipeline import Pipeline
             for nid, node in self.nodes.items():
                 self.pipelines[nid] = Pipeline(node, self.scheduler,
-                                               pipeline_config)
+                                               pipeline_config,
+                                               qos=self.qos_tiers.get(nid))
 
     def _build_node(self, nid: int) -> Node:
         """Construct (or reconstruct) one node and wire it to the cluster:
@@ -217,13 +229,53 @@ class SimCluster:
             service.report_topology(self.topology)
         return node
 
-    def pipeline_submit(self, node_id: int, txn):
-        """Client entry through the node's ingest pipeline (falls back to
-        direct coordination when the pipeline is off)."""
+    def _build_qos_tier(self, nid: int):
+        """Construct (or reconstruct, after restart_node) one node's QoS
+        admission tier.  Virtual time has no real loop lag, so the sim's
+        deterministic pressure signal is the pipeline ingest depth (looked
+        up lazily: the pipelines dict is built after the tiers and
+        repopulated on restart)."""
+        from accord_tpu.qos import PressureController, QosConfig, QosTier
+        node = self.nodes[nid]
+        config = self._qos_config if self._qos_config is not None \
+            else QosConfig()
+
+        def clock_us() -> int:
+            return int(self.queue.clock.now_us)
+
+        def depth_pressure(_nid=nid, _cfg=config) -> float:
+            p = self.pipelines.get(_nid)
+            return p.ingest.depth / _cfg.depth_target if p is not None \
+                else 0.0
+
+        controller = PressureController(config, clock_us,
+                                        sources=(depth_pressure,))
+        tier = QosTier(config, node.obs.registry, node.obs.flight, clock_us,
+                       controller=controller)
+        self.qos_tiers[nid] = tier
+        return tier
+
+    def pipeline_submit(self, node_id: int, txn, tenant: str = "",
+                        priority: str = ""):
+        """Client entry through the node's QoS tier (when on) and ingest
+        pipeline (falls back to direct coordination when the pipeline is
+        off)."""
+        tier = self.qos_tiers.get(node_id)
+        if tier is not None:
+            nack = tier.admit(tenant, priority or "normal")
+            if nack is not None:
+                from accord_tpu.utils.async_chains import AsyncResult
+                result = AsyncResult()
+                result.try_failure(nack)
+                return result
         p = self.pipelines.get(node_id)
-        if p is None:
-            return self.nodes[node_id].coordinate(txn)
-        return p.submit(txn)
+        result = (self.nodes[node_id].coordinate(txn) if p is None
+                  else p.submit(txn))
+        if tier is not None:
+            # admitted op settled (either way): shrink the tier's inflight
+            # backlog signal — deterministic, it rides the virtual queue
+            result.add_callback(lambda _v, _f: tier.op_done())
+        return result
 
     def _make_topology(self, epoch: int, node_ids: List[int], n_shards: int,
                        rf: int) -> Topology:
@@ -307,6 +359,7 @@ class SimCluster:
         node.journal = None  # a dead process journals nothing
         self.agents[node_id].dead = True
         self.pipelines.pop(node_id, None)
+        self.qos_tiers.pop(node_id, None)
         auditor = self.auditors.pop(node_id, None)
         if auditor is not None:
             auditor.stop()
@@ -340,10 +393,13 @@ class SimCluster:
             CoordinateDurabilityScheduling(
                 node, shard_cycle_s=self._durability_cycle_s,
                 global_cycle_every=self._durability_global_every).start()
+        if self._qos_enabled:
+            self._build_qos_tier(node_id)
         if self._pipeline_enabled:
             from accord_tpu.pipeline import Pipeline
-            self.pipelines[node_id] = Pipeline(node, self.scheduler,
-                                               self._pipeline_config)
+            self.pipelines[node_id] = Pipeline(
+                node, self.scheduler, self._pipeline_config,
+                qos=self.qos_tiers.get(node_id))
         self._attach_auditor(node_id)
         return node
 

@@ -32,7 +32,7 @@ from typing import Callable, Dict, List, Optional
 
 from accord_tpu.utils.random_source import RandomSource
 from accord_tpu.workload.arrival import make_offsets_us
-from accord_tpu.workload.profiles import build_txn, make_profile
+from accord_tpu.workload.profiles import Op, build_txn, make_profile
 
 # bounded exact-sample buffers: enough for sample-exact p99.9 at every
 # realistic lane size, bounded against a runaway caller
@@ -283,6 +283,415 @@ def run_open_loop_tcp(profile: str = "zipfian", ops: int = 300,
                           _collect(records, rate_per_s, sched, summary,
                                    t0_us),
                           summary, sched)
+
+
+# -------------------------------------------------------- overload lane ----
+
+class OverloadRec(OpRecord):
+    """One overload-lane op: the ledger row plus its QoS identity and the
+    client-side retry trail (attempts, nacks, whether the retry honored
+    the server's `retry_after_us` hint)."""
+
+    __slots__ = ("window", "tenant", "priority", "attempts", "qos_nacks",
+                 "honored", "retried")
+
+    def __init__(self, idx: int, intended_us: int, window: int,
+                 tenant: str, priority: str):
+        super().__init__(idx, intended_us)
+        self.window = window
+        self.tenant = tenant
+        self.priority = priority
+        self.attempts = 0
+        self.qos_nacks = 0
+        self.honored = 0   # resubmits that waited >= the hinted delay
+        self.retried = 0
+
+
+def _probe_capacity(client, prof, origin_rng, nodes: int, ops: int,
+                    concurrency: int, timeout_s: float = 60.0) -> dict:
+    """Closed-loop capacity probe: `concurrency` outstanding ops, next
+    submitted on each completion — the classic saturation measurement the
+    open-loop sweep's multipliers are anchored to.  Probes submit as
+    `high` so the armed QoS tier cannot nack them: the probe must measure
+    what the node can DO, not what the tenant buckets provision."""
+    t0 = time.monotonic()
+    sent = done = acked = 0
+    pending = 0
+    deadline = t0 + timeout_s
+    while done < ops and time.monotonic() < deadline:
+        while sent < ops and pending < concurrency:
+            op = prof.next_op()
+            client.submit(1 + origin_rng.next_int(nodes), op.reads,
+                          op.appends, f"probe-{sent}", priority="high")
+            sent += 1
+            pending += 1
+        frame = client.recv(1.0)
+        if frame is None:
+            continue
+        body = frame.get("body", {})
+        if body.get("type") == "submit_reply" and \
+                str(body.get("req", "")).startswith("probe-"):
+            pending -= 1
+            done += 1
+            if body.get("ok"):
+                acked += 1
+    duration_s = max(1e-9, time.monotonic() - t0)
+    return {"ops": ops, "concurrency": concurrency, "acked": acked,
+            "duration_s": round(duration_s, 3),
+            "per_s": round(acked / duration_s, 1)}
+
+
+def _overload_window_stats(recs: List["OverloadRec"], multiplier: float,
+                           rate_per_s: float, t0_us: int,
+                           span_us: int) -> dict:
+    """Fold one sweep window's ledger: goodput vs offered, per-class
+    open-loop quantiles, shed rate, and the retry-after honor trail.
+    Goodput counts acks landing INSIDE the arrival span over that span —
+    the steady-state service rate; the drain tail (late retries settling
+    after arrivals stop) is reported separately so windows with different
+    retry-tail shapes stay comparable."""
+    n = len(recs)
+    last_end = max([r.end_us for r in recs if r.end_us is not None],
+                   default=t0_us)
+    span_s = max(1e-9, span_us / 1e6)
+    acked = sum(1 for r in recs if r.outcome == "ack")
+    acked_in_span = sum(1 for r in recs if r.outcome == "ack"
+                        and r.end_us <= t0_us + span_us)
+    submit_span_s = max(1e-9, (max(r.submit_us or t0_us for r in recs)
+                               - t0_us) / 1e6) if recs else 1e-9
+    classes: Dict[str, dict] = {}
+    for pri in ("high", "normal", "best_effort"):
+        sub = [r for r in recs if r.priority == pri]
+        lat = sorted(max(0, r.end_us - r.intended_us) for r in sub
+                     if r.outcome == "ack")
+
+        def q(p: float) -> Optional[int]:
+            return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else None
+        classes[pri] = {
+            "count": len(sub), "acked": len(lat),
+            "shed": sum(1 for r in sub if r.outcome == "shed"),
+            "open_p50_us": q(0.50), "open_p99_us": q(0.99)}
+    nacks = sum(r.qos_nacks for r in recs)
+    retried = sum(r.retried for r in recs)
+    honored = sum(r.honored for r in recs)
+    pooled = sorted(max(0, r.end_us - r.intended_us) for r in recs
+                    if r.outcome == "ack")
+
+    def pq(p: float) -> Optional[int]:
+        return pooled[min(len(pooled) - 1,
+                          int(p * len(pooled)))] if pooled else None
+    return {
+        "multiplier": multiplier,
+        "offered_per_s": round(rate_per_s, 1),
+        "actual_offered_per_s": round(n / submit_span_s, 1),
+        "ops": n,
+        "acked": acked,
+        "shed": sum(1 for r in recs if r.outcome == "shed"),
+        # sheds applied at the client by flow suppression (never sent;
+        # attempts == 0) — a subset of "shed", split out for transparency
+        "client_shed": sum(1 for r in recs
+                           if r.outcome == "shed" and r.attempts == 0),
+        "failed": sum(1 for r in recs if r.outcome == "fail"),
+        "pending": sum(1 for r in recs if r.outcome is None),
+        "goodput_per_s": round(acked_in_span / span_s, 1),
+        "drain_s": round(max(0.0, (last_end - t0_us - span_us) / 1e6), 3),
+        "open_p50_us": pq(0.50), "open_p99_us": pq(0.99),
+        "shed_rate": round(sum(1 for r in recs if r.outcome == "shed")
+                           / n, 4) if n else 0.0,
+        "qos_nacks": nacks,
+        "retries": retried,
+        "retry_honor_rate": round(honored / retried, 4) if retried else None,
+        "classes": classes,
+    }
+
+
+def run_overload_tcp(profile: str = "uniform", schedule: str = "poisson",
+                     seed: int = 23, nodes: int = 3, keys: int = 64,
+                     n_shards: int = 4,
+                     multipliers=(0.5, 1.0, 2.0, 3.0, 5.0, 7.0, 10.0),
+                     window_s: float = 6.0, max_window_ops: int = 9000,
+                     probe_ops: int = 200, probe_concurrency: int = 8,
+                     capacity_per_s: Optional[float] = None,
+                     high_frac: float = 0.15, normal_frac: float = 0.35,
+                     max_retries: int = 2, gap_s: float = 1.5,
+                     settle_timeout_s: float = 30.0,
+                     want_phases: bool = True) -> OpenLoopResult:
+    """The slo-overload lane: an open-loop sweep over the live TCP cluster
+    from below to far past its measured capacity, with mixed tenants and
+    priority classes, the client honoring every QoS nack's
+    `retry_after_us` hint (jittered exponential backoff, bounded retries).
+
+    Sequence: (1) closed-loop capacity probe anchors the multipliers;
+    (2) one paced open-loop window per multiplier.  The `high` class is a
+    FIXED-RATE foreground — `high_frac` of CAPACITY, constant across
+    windows — while the bulk tiers (`normal_frac` `normal`, rest
+    `best_effort`, across tenants t0..t2) scale with the offered
+    multiplier.  That is what an SLO-protection test measures: a constant
+    paying workload whose latency must hold while background load runs
+    away.  (If high scaled with the multiplier, its tail at 10x would be
+    dominated by high-vs-high key conflicts — which admission can never
+    shed — and the measurement would say nothing about the QoS tier.)
+    The default profile is UNIFORM, deliberately: this lane measures the
+    ADMISSION tier, and a skewed profile's hot-key dependency chains add
+    an execution-side tail (a high txn must wait for every uncommitted
+    conflicting predecessor to commit — a wait no admission policy can
+    shed, since those predecessors were already admitted) that drowns the
+    signal being tested.  Conflict-heavy latency behavior has its own
+    lanes (slo-mixed, slo-zipf1m).  Each window is drained to quiescence
+    with a decay gap before the next so one window's pressure does not
+    bleed into the next's ledger; (3) the full ledger folds into
+    the standard SLO report plus an `overload` section: the
+    goodput-vs-offered curve, per-class open-loop p99, shed rate,
+    retry-after honor rate, and the exact client-side accounting identity
+    (acked + shed + failed + pending == submitted, per window).
+
+    An op nacked by QoS admission is retried after at least the hinted
+    delay (open-loop latency still charges from the ORIGINAL intended
+    start, so honored backoff is paid by the tail, not hidden); an op
+    whose retry budget is exhausted settles as shed.  The node processes
+    read ACCORD_QOS* from the ambient environment — the caller arms the
+    tier, this driver only exercises it."""
+    import heapq
+
+    from accord_tpu.host.tcp import TcpClusterClient
+
+    import random as _random
+
+    rng = RandomSource(seed)
+    prof = make_profile(profile, keys=keys, seed=rng.next_long())
+    origin_rng = rng.fork()
+    backoff_rng = _random.Random(seed ^ 0xBACC0FF)  # stdlib .random() API
+    mix_rng = rng.fork()
+
+    def now_us() -> int:
+        return int(time.time() * 1e6)
+
+    client = TcpClusterClient(n_nodes=nodes, n_shards=n_shards)
+    all_records: List[OverloadRec] = []
+    windows: List[dict] = []
+    summary = None
+    t0_us = now_us()
+    try:
+        probe = _probe_capacity(client, prof, origin_rng, nodes,
+                                probe_ops, probe_concurrency)
+        capacity = capacity_per_s if capacity_per_s else probe["per_s"]
+        if capacity <= 0:
+            raise RuntimeError(f"capacity probe found a dead cluster: "
+                               f"{probe}")
+        time.sleep(gap_s)
+
+        for widx, mult in enumerate(multipliers):
+            rate = capacity * mult
+            ops = min(max_window_ops, max(40, int(rate * window_s)))
+            offsets = make_offsets_us(schedule, rate, ops,
+                                      seed=rng.next_long())
+            # fresh profile on a DISJOINT token range per window: the
+            # list registers are append-only, so re-touching the probe's
+            # (or an earlier window's) hot keys would grow every read
+            # reply all sweep long and later windows would measure list
+            # length, not overload behavior
+            tok_off = (widx + 1) * keys
+            wprof = make_profile(profile, keys=keys, seed=rng.next_long())
+            ops_list = []
+            for _ in range(ops):
+                op = wprof.next_op()
+                ops_list.append(Op(
+                    reads=tuple(t + tok_off for t in op.reads),
+                    appends={t + tok_off: v
+                             for t, v in op.appends.items()},
+                    ephemeral=op.ephemeral))
+            origins = [1 + origin_rng.next_int(nodes) for _ in range(ops)]
+            base = (widx + 1) * 1_000_000
+            t0w = now_us()
+            recs: List[OverloadRec] = []
+            # high is high_frac of CAPACITY, not of offered load: the
+            # foreground stays constant while the bulk flood scales
+            p_high = min(1.0, high_frac / mult) if mult > 0 else high_frac
+            for i, off in enumerate(offsets):
+                roll = mix_rng.next_float()
+                pri = ("high" if roll < p_high
+                       else "normal" if roll < p_high + normal_frac
+                       else "best_effort")
+                recs.append(OverloadRec(i, t0w + off, widx,
+                                        f"t{mix_rng.next_int(3)}", pri))
+            by_req = {base + i: recs[i] for i in range(ops)}
+
+            def submit(i: int) -> None:
+                rec = recs[i]
+                rec.attempts += 1
+                if rec.submit_us is None:
+                    rec.submit_us = now_us()
+                op = ops_list[i]
+                client.submit(origins[i], op.reads, op.appends, base + i,
+                              want_phases=want_phases, tenant=rec.tenant,
+                              priority=rec.priority)
+
+            retryq: list = []  # (due_us, req, nack_at_us, hint_us)
+            unfinished = ops
+            # client-side flow control: a qos nack's retry_after_us is
+            # honored for the whole (origin, tenant, priority) FLOW, not
+            # just the nacked op — new bulk-tier ops of a suppressed flow
+            # are shed at the client without a round trip.  This is the
+            # other half of admission control: without it the nack flood
+            # itself saturates the host boundary at deep overload and
+            # every class pays the queueing tax.  high is never
+            # suppressed (the server never sheds it).  Retries are still
+            # sent on their own backoff — they are the probes that
+            # refresh the hint.
+            suppress_until: Dict[tuple, int] = {}
+
+            def handle(frame) -> bool:
+                nonlocal unfinished
+                body = frame.get("body", {})
+                if body.get("type") != "submit_reply":
+                    return False
+                rec = by_req.get(body.get("req"))
+                if rec is None:
+                    return False  # stale frame from a previous window
+                if rec.outcome is not None:
+                    return False
+                if body.get("ok"):
+                    rec.end_us = now_us()
+                    rec.outcome = "ack"
+                    if body.get("phases"):
+                        rec.phase_firsts = [(ph, at) for ph, at
+                                            in body["phases"]]
+                    unfinished -= 1
+                    return True
+                if body.get("qos"):
+                    rec.qos_nacks += 1
+                    if rec.priority != "high":
+                        flow = (origins[rec.idx], rec.tenant, rec.priority)
+                        until = now_us() + int(
+                            body.get("retry_after_us") or 0)
+                        if until > suppress_until.get(flow, 0):
+                            suppress_until[flow] = until
+                    # best_effort gets one fewer retry than the paying
+                    # classes: its nacks at deep overload are near-certain
+                    # to repeat, and the attempt flood is load too
+                    budget = (max_retries if rec.priority != "best_effort"
+                              else max(0, max_retries - 1))
+                    if rec.attempts <= budget:
+                        hint = int(body.get("retry_after_us") or 0)
+                        back = client.qos_backoff_us(
+                            body, attempt=rec.attempts, rng=backoff_rng)
+                        heapq.heappush(retryq,
+                                       (now_us() + back, base + rec.idx,
+                                        now_us(), hint))
+                        return True
+                    rec.end_us = now_us()
+                    rec.outcome = "shed"
+                    unfinished -= 1
+                    return True
+                rec.end_us = now_us()
+                rec.outcome = "shed" if body.get("shed") else "fail"
+                unfinished -= 1
+                return True
+
+            sent = 0
+            deadline = (time.monotonic() + (offsets[-1] if offsets else 0)
+                        / 1e6 + settle_timeout_s)
+            while unfinished > 0 and time.monotonic() < deadline:
+                now = now_us()
+                while retryq and retryq[0][0] <= now:
+                    _due, req, nack_at, hint = heapq.heappop(retryq)
+                    rec = by_req[req]
+                    rec.retried += 1
+                    if now - nack_at >= hint:
+                        rec.honored += 1
+                    submit(rec.idx)
+                if sent < ops and now >= recs[sent].intended_us:
+                    nrec = recs[sent]
+                    if (nrec.priority != "high"
+                            and suppress_until.get(
+                                (origins[sent], nrec.tenant,
+                                 nrec.priority), 0) > now):
+                        # flow suppressed: client-side shed, attempts
+                        # stays 0 (how window stats tell these apart)
+                        nrec.end_us = now
+                        nrec.outcome = "shed"
+                        unfinished -= 1
+                    else:
+                        submit(sent)
+                    sent += 1
+                    # drain ready replies before the next arrival: when
+                    # the client runs behind schedule it submits back to
+                    # back, and without this the acks age unread in the
+                    # inbox — inflating measured open-loop latency with
+                    # client queueing, not server behavior
+                    while True:
+                        frame = client.recv(0)
+                        if frame is None:
+                            break
+                        handle(frame)
+                    continue
+                next_due = min(
+                    [recs[sent].intended_us] if sent < ops else [],
+                    default=retryq[0][0] if retryq else now + 50_000)
+                if retryq and retryq[0][0] < next_due:
+                    next_due = retryq[0][0]
+                frame = client.recv(
+                    min(0.05, max(0.001, (next_due - now) / 1e6)))
+                if frame is not None:
+                    handle(frame)
+            windows.append(_overload_window_stats(
+                recs, mult, rate, t0w, offsets[-1] if offsets else 0))
+            all_records.extend(recs)
+            time.sleep(gap_s)  # let the lag EWMA decay between windows
+
+        # obs snapshots AFTER the channel quiesces: the merged summary's
+        # "qos" section carries the server-side accounting identity
+        from accord_tpu.obs.report import merge_node_snapshots
+        snaps = [client.fetch_metrics(i, timeout_s=10.0)
+                 for i in range(1, nodes + 1)]
+        merged = merge_node_snapshots([s for s in snaps if s])
+        summary = merged["summary"] if merged["nodes"] else None
+    finally:
+        client.close()
+
+    total = len(all_records)
+    span_s = max(1e-9, (max((r.intended_us for r in all_records),
+                            default=t0_us) - t0_us) / 1e6)
+    sched = {"kind": schedule, "rate_per_s": round(total / span_s, 1),
+             "ops": total, "seed": seed, "host": "tcp-overload"}
+    report = _collect(all_records, total / span_s, sched, summary, t0_us)
+
+    def _w(mult: float) -> Optional[dict]:
+        for w in windows:
+            if w["multiplier"] == mult:
+                return w
+        return None
+    peak = max((w["goodput_per_s"] for w in windows), default=0.0)
+    at5, uncontended = _w(5.0), _w(0.5)
+    counts = {"submitted": total,
+              "acked": sum(w["acked"] for w in windows),
+              "shed": sum(w["shed"] for w in windows),
+              "failed": sum(w["failed"] for w in windows),
+              "pending": sum(w["pending"] for w in windows)}
+    counts["exact"] = (counts["acked"] + counts["shed"] + counts["failed"]
+                       + counts["pending"] == counts["submitted"])
+    retried = sum(w["retries"] for w in windows)
+    honored = sum(r.honored for r in all_records)
+    report["overload"] = {
+        "capacity_probe": probe,
+        "capacity_per_s": capacity,
+        "windows": windows,
+        "peak_goodput_per_s": peak,
+        "goodput_at_5x_frac_of_peak":
+            round(at5["goodput_per_s"] / peak, 4) if at5 and peak else None,
+        # the uncontended baseline for the high class is the 0.5x window's
+        # POOLED open-loop p99: nothing sheds there, so priority classes
+        # are exchangeable and the pooled quantile is the same distribution
+        # at ~10x the sample size of the high slice alone
+        "high_p99_uncontended_us": (uncontended or {}).get("open_p99_us"),
+        "high_p99_at_5x_us":
+            (at5 or {}).get("classes", {}).get("high", {})
+            .get("open_p99_us"),
+        "retry_honor_rate": round(honored / retried, 4) if retried else None,
+        "accounting": counts,
+        "server_qos": (summary or {}).get("qos"),
+    }
+    return OpenLoopResult(all_records, report, summary, sched)
 
 
 # --------------------------------------------------------- reshard lane ----

@@ -97,9 +97,12 @@ class ZipfianProfile(Profile):
 
     name = "zipfian"
 
+    def _token(self) -> int:
+        return self.rng.next_zipf(self.keys)
+
     def next_op(self) -> Op:
         rng = self.rng
-        tokens = sorted({rng.next_zipf(self.keys)
+        tokens = sorted({self._token()
                          for _ in range(1 + rng.next_int(3))})
         if rng.next_float() < 0.7:
             appends = {t: self._value() for t in tokens
@@ -109,6 +112,19 @@ class ZipfianProfile(Profile):
                 tuple(t for t in tokens if t not in appends)
             return Op(reads=reads, appends=appends)
         return Op(reads=tuple(tokens))
+
+
+class UniformProfile(ZipfianProfile):
+    """The zipfian mix SHAPE (1-3 tokens, ~70% writes, RMWs read what
+    they write) drawn over a UNIFORM keyspace: the conflict-light control
+    for lanes that measure admission/scheduling rather than contention
+    (slo-overload) — a skewed draw's hot-key dependency chains add an
+    execution-side tail orthogonal to what those lanes test."""
+
+    name = "uniform"
+
+    def _token(self) -> int:
+        return self.rng.next_int(self.keys)
 
 
 class RangeMixProfile(ZipfianProfile):
@@ -180,8 +196,8 @@ class EphemeralReadHeavyProfile(Profile):
         return Op(reads=(token,), appends={token: self._value()})
 
 
-PROFILES = {p.name: p for p in (ZipfianProfile, RangeMixProfile,
-                                TpccNewOrderProfile,
+PROFILES = {p.name: p for p in (ZipfianProfile, UniformProfile,
+                                RangeMixProfile, TpccNewOrderProfile,
                                 EphemeralReadHeavyProfile)}
 
 

@@ -293,7 +293,9 @@ def test_issue10_tcp_cpu_row_improved_vs_pr9_baseline():
     assert entry["value"] >= 230, entry["value"]
 
 
+# ------------------------------------ durable-WAL SLO lane (ISSUE 11) --
 
+def test_journal_slo_guard_dry_run_validates_row_schema():
     """The durable-WAL SLO lane (fsync-stall arm's home) must carry a
     schema-valid exact-sample SLO row like every other slo-* lane."""
     proc = _run(["--config", "slo-journal", "--guard", "--dry-run"])
@@ -414,3 +416,69 @@ def test_zipf1m_guard_dry_run_rejects_broken_paging_rows(tmp_path):
                 {"ACCORD_BENCH_HISTORY": str(hist)})
     assert proc.returncode != 0
     assert "resident_high_water" in (proc.stderr + proc.stdout)
+
+
+# ---------------------------- graceful-overload QoS lane (ISSUE 16) --
+
+def test_overload_guard_dry_run_validates_overload_row_schema():
+    """The recorded slo-overload row must stay guard-parseable AND carry
+    the graceful-degradation verdicts the lane exists for: exact shed
+    accounting, a goodput plateau past saturation, a bounded high-class
+    tail, and the retry-after honor rate — with high absent from every
+    server-side shed/throttle tally (it is never QoS-rejected)."""
+    proc = _run(["--config", "slo-overload", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "slo-overload_guard" and row["dry_run"] is True
+    assert row["baselines"], "no slo-overload baseline in BENCH_HISTORY.json"
+    assert row["baselines"][0]["slo_open_p99_us"] > 0
+    hist = json.load(open(os.path.join(
+        REPO, os.environ.get("ACCORD_BENCH_HISTORY",
+                             "BENCH_HISTORY.json"))))
+    ov = hist["slo-overload"]["host"]["slo"]["overload"]
+    acc = ov["accounting"]
+    assert acc["exact"] is True and acc["pending"] == 0
+    assert acc["shed"] > 0, "a 10x sweep that never shed measured nothing"
+    assert ov["goodput_at_5x_frac_of_peak"] >= 0.9
+    assert ov["high_p99_at_5x_us"] <= 2 * ov["high_p99_uncontended_us"]
+    assert ov["retry_honor_rate"] == 1.0, ov["retry_honor_rate"]
+    sq = ov["server_qos"]
+    assert sq["admitted"] + sq["shed"] + sq["throttled"] == sq["submitted"]
+    assert "high" not in sq.get("shed_by_priority", {}), sq
+    assert "high" not in sq.get("throttled_by_priority", {}), sq
+    # the sweep itself: multipliers span sub- to deep-overload
+    mults = [w["multiplier"] for w in ov["windows"]]
+    assert min(mults) <= 0.5 and max(mults) >= 10, mults
+
+
+def test_overload_guard_dry_run_rejects_broken_rows(tmp_path):
+    """A slo-overload row with broken shed accounting or a collapsed
+    goodput plateau must fail the dry run — a degraded baseline must fail
+    CI, not silently keep gating the overload story."""
+    good = json.load(open(os.path.join(REPO, "BENCH_HISTORY.json")))
+    hist = tmp_path / "hist.json"
+
+    lane = json.loads(json.dumps(good["slo-overload"]))  # deep copy
+    lane["host"]["slo"]["overload"]["accounting"]["exact"] = False
+    hist.write_text(json.dumps({"slo-overload": lane}))
+    proc = _run(["--config", "slo-overload", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "accounting identity" in (proc.stderr + proc.stdout)
+
+    lane = json.loads(json.dumps(good["slo-overload"]))
+    lane["host"]["slo"]["overload"]["goodput_at_5x_frac_of_peak"] = 0.4
+    hist.write_text(json.dumps({"slo-overload": lane}))
+    proc = _run(["--config", "slo-overload", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "goodput collapsed" in (proc.stderr + proc.stdout)
+
+    lane = json.loads(json.dumps(good["slo-overload"]))
+    lane["host"]["slo"]["overload"]["high_p99_at_5x_us"] = \
+        10 * lane["host"]["slo"]["overload"]["high_p99_uncontended_us"]
+    hist.write_text(json.dumps({"slo-overload": lane}))
+    proc = _run(["--config", "slo-overload", "--guard", "--dry-run"],
+                {"ACCORD_BENCH_HISTORY": str(hist)})
+    assert proc.returncode != 0
+    assert "blew out" in (proc.stderr + proc.stdout)

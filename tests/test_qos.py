@@ -1,0 +1,279 @@
+"""QoS admission tier (accord_tpu/qos/): unit determinism + hostile burn.
+
+Unit layer: the token bucket (epsilon take, overdraft floor + debt
+repayment on the shared tenant bucket), the adaptive pressure controller
+(rise-fast/decay-on-clock EWMA, saturation floor), and the tier's
+decision order — all on injected clocks, so every assertion is exact.
+
+Burn layer: the full nemesis stack (loss + scheduled partitions + clock
+drift + topology churn + crash-restart) with `qos=True`, asserting the
+exact per-class shed accounting and the fairness invariant (high is never
+QoS-shed while best_effort is being admitted); plus the differential run
+pinning that QoS off — the default — leaves the submit path bit-identical.
+"""
+
+import pytest
+
+from accord_tpu.qos import (PRIORITIES, PressureController, QosConfig,
+                            QosRejected, QosTier, TokenBucket,
+                            qos_tier_from_env)
+from accord_tpu.obs.registry import Registry
+from accord_tpu.sim.burn import BurnRun
+
+
+class _Clock:
+    def __init__(self, now_us: int = 0):
+        self.now_us = now_us
+
+    def __call__(self) -> int:
+        return self.now_us
+
+    def advance_us(self, d: int) -> None:
+        self.now_us += d
+
+
+def _tier(config: QosConfig, clock: _Clock) -> QosTier:
+    return QosTier(config, Registry(), None, clock,
+                   controller=PressureController(config, clock))
+
+
+# ---------------------------------------------------------- token bucket --
+
+def test_token_bucket_burst_then_refill_epsilon():
+    clock = _Clock()
+    b = TokenBucket(rate_per_s=10.0, burst=5.0, now_us=clock())
+    # a fresh tenant gets its whole burst
+    for _ in range(5):
+        assert b.try_take(clock()) == 0.0
+    # empty: the refusal quotes the exact refill delay for one token
+    refill = b.try_take(clock())
+    assert refill == pytest.approx(100_000.0)
+    # advancing EXACTLY one token's refill must succeed — float refill
+    # arithmetic lands epsilon-shy of 1.0 and the bucket must still count
+    # it as a whole token
+    clock.advance_us(100_000)
+    assert b.try_take(clock()) == 0.0
+    assert b.try_take(clock()) > 0.0
+
+
+def test_token_bucket_overdraw_floor_and_debt_repayment():
+    clock = _Clock()
+    b = TokenBucket(rate_per_s=10.0, burst=4.0, now_us=clock())
+    # a high-priority surge drives the bucket negative, floored at -burst
+    for _ in range(20):
+        b.overdraw(clock())
+    assert b.tokens == -4.0
+    # the debt is repaid out of the refill: bulk tiers see a refill delay
+    # covering the full 5-token gap (from -4 up to 1) ...
+    assert b.try_take(clock()) == pytest.approx(500_000.0)
+    # ... and 400ms of refill only clears the debt, not a bulk token
+    clock.advance_us(400_000)
+    assert b.try_take(clock()) > 0.0
+    assert b.tokens == pytest.approx(0.0)
+    clock.advance_us(100_000)
+    assert b.try_take(clock()) == 0.0
+
+
+def test_token_bucket_refill_caps_at_burst():
+    clock = _Clock()
+    b = TokenBucket(rate_per_s=100.0, burst=3.0, now_us=clock())
+    clock.advance_us(60_000_000)
+    b.try_take(clock())
+    assert b.tokens == pytest.approx(2.0)
+
+
+# ---------------------------------------------------- pressure controller --
+
+def test_pressure_controller_rises_fast_and_decays_on_clock():
+    clock = _Clock()
+    cfg = QosConfig(lag_target_us=50_000.0, ewma_half_life_s=0.5)
+    ctl = PressureController(cfg, clock)
+    assert ctl.pressure() == 0.0
+    # one 100ms-late timer: EWMA jumps half the gap → 50ms == target → 1.0
+    ctl.observe_lag(0.1)
+    assert ctl.pressure() == pytest.approx(1.0)
+    # recovery needs no new timer fires: one half-life halves the pressure
+    clock.advance_us(500_000)
+    assert ctl.pressure() == pytest.approx(0.5)
+    clock.advance_us(1_000_000)
+    assert ctl.pressure() == pytest.approx(0.125)
+
+
+def test_pressure_controller_saturation_floors_into_normal_band():
+    class _LH:
+        saturated = True
+
+    clock = _Clock()
+    cfg = QosConfig(normal_pressure=2.0)
+    ctl = PressureController(cfg, clock, loop_health=_LH())
+    # a saturated loop sheds `normal` too, not just best_effort
+    assert ctl.pressure() == pytest.approx(2.0)
+
+
+def test_pressure_controller_takes_max_of_sources():
+    clock = _Clock()
+    cfg = QosConfig()
+    ctl = PressureController(cfg, clock, sources=(lambda: 0.3, lambda: 1.7))
+    assert ctl.pressure() == pytest.approx(1.7)
+
+
+# ----------------------------------------------------------------- tier --
+
+def test_tier_inflight_backlog_sheds_by_class_and_op_done_recovers():
+    clock = _Clock()
+    tier = _tier(QosConfig(depth_target=2.0), clock)
+    # fill the backlog: inflight/depth_target crosses 1.0 at 2 in flight
+    assert tier.admit("t0", "best_effort") is None
+    assert tier.admit("t0", "best_effort") is None
+    nack = tier.admit("t0", "best_effort")
+    assert isinstance(nack, QosRejected) and nack.reason == "shed"
+    # normal rides until double the pressure (2.0 → 4 in flight) ...
+    assert tier.admit("t0", "normal") is None
+    assert tier.admit("t0", "normal") is None
+    assert tier.admit("t0", "normal").reason == "shed"
+    # ... and high is NEVER pressure-shed
+    for _ in range(16):
+        assert tier.admit("t0", "high") is None
+    assert tier.inflight == 20
+    # settling admitted ops reopens the lower classes
+    for _ in range(19):
+        tier.op_done()
+    assert tier.admit("t0", "best_effort") is None
+
+
+def test_tier_high_overdraws_tenant_bucket_never_throttled():
+    clock = _Clock()
+    tier = _tier(QosConfig(rate_per_s=5.0, burst=2.0, depth_target=1e9),
+                 clock)
+    # high drains the tenant bucket deep past empty without one throttle
+    for _ in range(10):
+        assert tier.admit("t0", "high") is None
+    # the same tenant's bulk traffic now pays the overdraft debt
+    nack = tier.admit("t0", "normal")
+    assert isinstance(nack, QosRejected) and nack.reason == "throttle"
+    assert nack.retry_after_us > 0
+    # other tenants are untouched — buckets are per-tenant
+    assert tier.admit("t1", "normal") is None
+
+
+def test_tier_retry_after_floor_scales_with_pressure():
+    clock = _Clock()
+    cfg = QosConfig(depth_target=1.0, retry_floor_us=10_000)
+    tier = _tier(cfg, clock)
+    for _ in range(4):
+        assert tier.admit("t0", "high") is None
+    # pressure is inflight/depth_target == 4.0; an inflight-clamped node
+    # has LOW measured lag, so the hint must ride the scaled floor
+    nack = tier.admit("t0", "best_effort")
+    assert nack.reason == "shed"
+    assert nack.retry_after_us >= 40_000
+
+
+def test_tier_accounting_identity_per_label():
+    clock = _Clock()
+    registry = Registry()
+    cfg = QosConfig(rate_per_s=3.0, burst=1.0, depth_target=4.0)
+    tier = QosTier(cfg, registry, None, clock,
+                   controller=PressureController(cfg, clock))
+    import itertools
+    for i, (tenant, priority) in enumerate(itertools.product(
+            ("t0", "t1"), PRIORITIES)):
+        for _ in range(5 + i):
+            tier.admit(tenant, priority)
+    # exported identity: admitted + shed + throttled == submitted for
+    # every (tenant, priority) label pair — the burn and the slo-overload
+    # bench lane both assert the client-side mirror of this
+    series = {}
+    for (name, lk), c in registry._counters.items():
+        if name.startswith("accord_qos_") and "tenant=" in lk:
+            series.setdefault(lk, {})[name] = c.value
+    assert len(series) == 6
+    for lk, vals in series.items():
+        assert (vals.get("accord_qos_admitted_total", 0)
+                + vals.get("accord_qos_shed_total", 0)
+                + vals.get("accord_qos_throttled_total", 0)
+                == vals["accord_qos_submitted_total"]), (lk, vals)
+
+
+def test_tier_unknown_priority_coerces_to_normal():
+    clock = _Clock()
+    tier = _tier(QosConfig(depth_target=1.0), clock)
+    assert tier.admit("t0", "high") is None
+    assert tier.admit("t0", "high") is None
+    # pressure 2.0: normal sheds — an unknown class must not ride the
+    # high lane by accident
+    nack = tier.admit("t0", "launch_critical")
+    assert isinstance(nack, QosRejected) and nack.priority == "normal"
+
+
+def test_qos_tier_from_env_gate(monkeypatch):
+    clock = _Clock()
+    monkeypatch.delenv("ACCORD_QOS", raising=False)
+    assert qos_tier_from_env(Registry(), None, clock) is None
+    monkeypatch.setenv("ACCORD_QOS", "0")
+    assert qos_tier_from_env(Registry(), None, clock) is None
+    monkeypatch.setenv("ACCORD_QOS", "1")
+    monkeypatch.setenv("ACCORD_QOS_RATE", "7")
+    tier = qos_tier_from_env(Registry(), None, clock)
+    assert isinstance(tier, QosTier)
+    assert tier.config.rate_per_s == 7.0
+
+
+# ----------------------------------------------------------------- burn --
+
+def test_burn_hostile_qos_full_nemesis(tmp_path):
+    """QoS hostile acceptance: the admission tier under the FULL nemesis
+    stack — loss, scheduled partitions, clock drift, topology churn,
+    crash-restart — with the ingest pipeline armed behind it.  The
+    client-side per-class tallies are exact across the restart (a killed
+    node's registry resets; the client's view cannot), and the fairness
+    invariant holds: high is never QoS-shed while best_effort traffic is
+    being admitted and acked."""
+    run = BurnRun(29, 120, drop_prob=0.08, partitions=True,
+                  clock_drift=True, restarts=1, journal_dir=str(tmp_path),
+                  pipeline=True, qos=True,
+                  qos_config=QosConfig(depth_target=4.0))
+    stats = run.run()
+    assert stats.acks > 0, "pathological: no transaction succeeded"
+    assert stats.lost == 0 and stats.pending == 0
+    assert stats.restarts == 1
+    assert run.partition_nemesis.partitions_applied > 0
+    cs = run.qos_class_stats
+    # exact accounting: every submitted op landed in exactly one per-class
+    # outcome bucket (acks/sheds/throttles/inner/failures), client-side
+    total = sum(v for c in cs.values() for v in c.values())
+    assert total == 120, cs
+    assert all(c["lost"] == 0 for c in cs.values()), cs
+    # the overload machinery actually fired ...
+    assert sum(c["qos_shed"] for c in cs.values()) > 0, cs
+    # ... and fairness held: high never QoS-shed, best_effort still got
+    # real work through between pressure peaks
+    assert cs["high"]["qos_shed"] == 0 and cs["high"]["qos_throttle"] == 0, cs
+    assert cs["best_effort"]["acked"] > 0, cs
+    # the merged registry report carries the qos section (counters are
+    # lower bounds under crash-restart — the killed node's tallies reset)
+    qos_rep = run.metrics_snapshot()["summary"]["qos"]
+    assert qos_rep["submitted"] > 0
+    assert "high" not in qos_rep.get("shed_by_priority", {}), qos_rep
+
+
+def test_burn_qos_off_default_bit_identical():
+    """Differential pin for the default-off gate: a run with the defaults
+    (no `qos` argument) and a run with `qos=False` spelled out must be
+    BIT-IDENTICAL — same outcome tallies, same virtual-event count, same
+    final histories — and neither constructs a tier.  This is what lets
+    the QoS plumbing ship inert: with the gate off the submit path spends
+    no rng draws, no admission state, nothing."""
+    runs = []
+    for kwargs in ({}, {"qos": False, "qos_config": None}):
+        run = BurnRun(31, 60, drop_prob=0.05, **kwargs)
+        stats = run.run()
+        assert not run.cluster.qos_tiers, "gate off must build no tier"
+        assert run.qos_class_stats == {}
+        runs.append((stats, run.cluster.queue.processed,
+                     run._final_histories()))
+    (s1, p1, h1), (s2, p2, h2) = runs
+    assert (s1.acks, s1.nacks, s1.shed, s1.lost, s1.pending) == \
+        (s2.acks, s2.nacks, s2.shed, s2.lost, s2.pending)
+    assert p1 == p2
+    assert h1 == h2

@@ -235,3 +235,16 @@ def test_journal_lifecycle_kinds_are_covered():
         assert kind in recorded, f"nothing records {kind}"
         assert any(p.startswith("journal") for p in recorded[kind]), \
             (kind, recorded[kind])
+
+
+def test_qos_kinds_are_covered():
+    """The admission tier's three verdicts — admit, shed, throttle — must
+    stay on the forensics ring: shed accounting audits hang off these
+    events, so a silently-dropped record would break the exactness story
+    without failing any functional test."""
+    recorded = _recorded_flight_kinds()
+    for kind in ("qos_admit", "qos_shed", "qos_throttle"):
+        assert kind in EVENT_KINDS, f"{kind} missing from EVENT_KINDS"
+        assert kind in recorded, f"nothing records {kind}"
+        assert any(p.startswith("qos") for p in recorded[kind]), \
+            (kind, recorded[kind])

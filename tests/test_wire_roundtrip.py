@@ -538,3 +538,69 @@ def test_journal_record_codec_round_trips(harvested):
         decoded = decode_record(encode_record(msg))
         assert type(decoded) is type(msg)
         assert canonical_encoding(decoded) == canonical_encoding(msg)
+
+
+def _qos_frames(seed: int, n: int = 40):
+    """Synthesized QoS wire shapes: submit frames carrying tenant/priority
+    and the admission tier's typed nack reply."""
+    rng = RandomSource(seed)
+    frames = []
+    for _ in range(n):
+        pri = ("high", "normal", "best_effort")[rng.next_int(3)]
+        frames.append({"src": 0, "body": {
+            "type": "submit", "req": int(rng.next_int(1 << 20)),
+            "reads": [int(rng.next_int(0, 999))
+                      for _ in range(1 + rng.next_int(3))],
+            "appends": {str(rng.next_int(0, 999)): int(rng.next_int(1 << 16))},
+            "ephemeral": bool(rng.next_bool()),
+            "tenant": f"t{rng.next_int(5)}", "priority": pri}})
+        frames.append({"src": 1 + rng.next_int(3), "body": {
+            "type": "submit_reply", "req": int(rng.next_int(1 << 20)),
+            "ok": False, "error": "QosRejected('qos shed')", "shed": True,
+            "qos": True, "reason": ("shed", "throttle")[rng.next_int(2)],
+            "retry_after_us": int(rng.next_int(2_000_000))}})
+    return frames
+
+
+def test_qos_submit_and_nack_frames_round_trip_both_tiers():
+    """Tenant/priority-carrying submit frames and the QoS nack reply shape
+    survive pack_frame/unpack_frame, and the two pack tiers stay
+    byte-identical over them — a frame a py-tier client sends must mean
+    the same thing to a native-tier node and vice versa."""
+    from accord_tpu.host import wire
+    from accord_tpu.host.wire import pack_frame, unpack_frame
+
+    _, nat_pack, nat_unpack, _ = _codec_tiers()
+    for frame in _qos_frames(20816):
+        packed = pack_frame(frame)
+        assert unpack_frame(packed) == frame
+        out = bytearray()
+        wire._py_pack_value(frame, out)
+        if nat_pack is not None:
+            assert nat_pack(frame) == bytes(out)
+            assert nat_unpack(bytes(out)) == frame
+
+
+def test_qos_rejected_exception_codec_round_trips():
+    """QosRejected rides replies through the wire exception codec: name +
+    message survive AND the machine-readable nack payload (retry_after_us,
+    tenant, priority, reason) is re-attached on decode — the client's
+    backoff contract."""
+    from accord_tpu.host.wire import decode_message, encode_message
+    from accord_tpu.qos.admission import QosRejected
+
+    rng = RandomSource(416)
+    for _ in range(25):
+        exc = QosRejected(
+            f"qos shed: pressure {rng.next_int(100)}",
+            retry_after_us=int(rng.next_int(2_000_000)),
+            tenant=f"t{rng.next_int(4)}",
+            priority=("high", "normal", "best_effort")[rng.next_int(3)],
+            reason=("shed", "throttle", "inner")[rng.next_int(3)])
+        back = decode_message(encode_message(exc))
+        assert type(back) is QosRejected
+        assert str(back) == str(exc)
+        assert back.retry_after_us == exc.retry_after_us
+        assert back.tenant == exc.tenant
+        assert back.priority == exc.priority
+        assert back.reason == exc.reason
