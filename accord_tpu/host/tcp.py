@@ -243,6 +243,11 @@ class _PeerLane:
         self.frames_q: deque = deque()  # packed frames awaiting the socket
         self.head_off = 0               # bytes of frames_q[0] already sent
         self.buffered_bytes = 0
+        # bytes held by the geo egress shim (frames waiting out their
+        # injected one-way delay on the scheduler before joining frames_q);
+        # counted against the admission bound so a WAN lane under load
+        # still backpressures
+        self.delayed_bytes = 0
         self.max_buffered = _env_int("ACCORD_TCP_PEER_BUF_BYTES", 8 << 20)
         self.max_pending = _env_int("ACCORD_TCP_PEER_INFLIGHT", 4096)
         self.sock: Optional[socket.socket] = None
@@ -262,7 +267,8 @@ class _PeerLane:
     # ----------------------------------------------------------- egress --
     def enqueue(self, body: dict) -> None:
         if len(self.pending) >= self.max_pending \
-                or self.buffered_bytes > self.max_buffered:
+                or self.buffered_bytes + self.delayed_bytes \
+                > self.max_buffered:
             self.shed += 1  # backpressure: shed like a drop-tail link
             return
         self.pending.append(body)
@@ -299,6 +305,44 @@ class _PeerLane:
         self._h_frame_msgs.observe(len(bodies))
         self.host.flight.record("frame_flush", None,
                                 (self.to, len(bodies), len(data)))
+        # getattr: unit tests drive lanes with a minimal host stub that
+        # predates the geo field
+        geo = getattr(self.host, "geo", None)
+        if geo is not None:
+            cls = geo.link_class(self.host.my_id, self.to)
+            if cls is not None:
+                # per-link-class census with REAL frame bytes (the wan
+                # report's WAN bytes/txn numerator)
+                reg = self.host.node.obs.registry
+                reg.counter("accord_link_msgs_total",
+                            cls=cls).inc(len(bodies))
+                reg.counter("accord_link_frames_total", cls=cls).inc()
+                reg.counter("accord_link_bytes_total",
+                            cls=cls).inc(len(packed))
+                d = geo.one_way_nominal_us(self.host.my_id, self.to)
+                if d:
+                    # tc-free egress delay shim: hold the packed frame on
+                    # the loop's own timer heap for the nominal one-way
+                    # delay.  The delay is CONSTANT per pair and the heap
+                    # is FIFO-stable on ties, so per-lane frame order is
+                    # preserved.
+                    self.delayed_bytes += len(packed)
+                    self.host.scheduler.once(
+                        d / 1e6, lambda p=packed: self._release(p))
+                    return
+        self.frames_q.append(packed)
+        self.buffered_bytes += len(packed)
+        self._g_buffered.value = self.buffered_bytes
+        if self.sock is None and not self.connecting:
+            self._connect()
+        elif self.sock is not None and not self.connecting:
+            self.drain()
+
+    def _release(self, packed: bytes) -> None:
+        """A geo-delayed frame served its injected one-way latency: move
+        it onto the socket FIFO (loop thread — scheduler timers run in
+        run_due)."""
+        self.delayed_bytes -= len(packed)
         self.frames_q.append(packed)
         self.buffered_bytes += len(packed)
         self._g_buffered.value = self.buffered_bytes
@@ -594,10 +638,21 @@ class TcpHost:
         from accord_tpu.impl.config_service import LedgerConfigService
         from accord_tpu.messages.admin import EpochInstall
         self.config_service = LedgerConfigService(
-            my_id, peers_hook=self._merge_peers)
+            my_id, peers_hook=self._merge_peers,
+            geo_hook=self._install_geo_wire)
         self.config_service.attach_node(self.node)
         self.config_service.remember_spec(EpochInstall.from_topology(topology))
         self.config_service.report_topology(topology)
+
+        # ACCORD_GEO=<json spec>: geo placement profile (topology/geo.py)
+        # — DC labels on this node's obs and a tc-free egress delay shim
+        # injecting the nominal one-way latency per peer lane.  A profile
+        # riding a later EpochInstall frame replaces it cluster-wide.
+        self.geo = None
+        from accord_tpu.topology.geo import GeoProfile
+        geo_env = GeoProfile.from_env(os.environ.get("ACCORD_GEO"))
+        if geo_env is not None:
+            self.install_geo_profile(geo_env)
 
         # ACCORD_JOURNAL=<dir>: durable write-ahead journal under
         # <dir>/node-<id> — existing state replays into the node BEFORE any
@@ -1057,10 +1112,29 @@ class TcpHost:
     def _merge_peers(self, peers) -> None:
         """An epoch install's `peers` spec taught us addresses (a node
         joining in that epoch): merge them so lazily-created lanes can
-        connect.  Loop thread (installs arrive via dispatch)."""
-        for nid, host, port in peers:
-            if int(nid) != self.my_id:
-                self.peers[int(nid)] = (host, int(port))
+        connect.  Specs may carry a 4th element (the peer's DC under a geo
+        profile) — placement itself comes from the profile, so the tag is
+        informational here.  Loop thread (installs arrive via dispatch)."""
+        for spec in peers:
+            nid, host, port = int(spec[0]), spec[1], int(spec[2])
+            if nid != self.my_id:
+                self.peers[nid] = (host, port)
+
+    def _install_geo_wire(self, geo) -> None:
+        """Config-service hook: a geo profile arrived on an EpochInstall
+        frame (GeoProfile.to_wire form)."""
+        from accord_tpu.topology.geo import GeoProfile
+        self.install_geo_profile(GeoProfile.from_wire(geo))
+
+    def install_geo_profile(self, profile) -> None:
+        """Install/replace the geo placement profile: per-peer egress
+        delay shim (see _PeerLane.flush — frames wait out the nominal
+        one-way delay on the loop's own timer heap, no `tc`, no root) and
+        dc= labels on this node's coordination obs."""
+        self.geo = profile
+        dc = profile.dc_of(self.my_id)
+        self.node.obs.set_dc(dc)
+        self.flight.record("geo_install", None, (profile.name, dc))
 
     def _topology_spec(self) -> dict:
         topo = self.node.topology.current()
@@ -1076,10 +1150,16 @@ class TcpHost:
         from accord_tpu.messages.admin import EpochInstall
         spec = body.get("topology", {})
         peers = spec.get("peers")
+        geo = spec.get("geo")
+        if geo:
+            # JSON spec dict -> canonical wire tuples
+            from accord_tpu.topology.geo import GeoProfile
+            geo = GeoProfile.from_spec(geo)
         install = EpochInstall(
             int(spec["epoch"]),
             [(s[0], s[1], tuple(s[2])) for s in spec["shards"]],
-            peers=[tuple(p) for p in peers] if peers else None)
+            peers=[tuple(p) for p in peers] if peers else None,
+            geo=geo or None)
         self.node.receive(install, 0, None)
 
         def ack():
@@ -1515,17 +1595,23 @@ class TcpClusterClient:
         return 1
 
     def install_epoch(self, epoch: int, shards, peers=None, contact: int = 1,
-                      timeout_s: float = 30.0) -> Optional[dict]:
+                      timeout_s: float = 30.0, geo=None) -> Optional[dict]:
         """Admin-plane epoch proposal: `shards` is [[start, end, [nodes]],
-        ...], `peers` optionally [[id, host, port], ...] for members joining
-        in this epoch.  One contact suffices — the install is journaled
-        there before the ack and gossips to every member."""
+        ...], `peers` optionally [[id, host, port], ...] (a 4th element
+        tags the peer's DC) for members joining in this epoch; `geo`
+        optionally ships a whole GeoProfile (or its to_spec dict) so one
+        contact installs the latency matrix cluster-wide.  The install is
+        journaled there before the ack and gossips to every member."""
         req = f"epoch-{epoch}-{contact}"
         topo = {"epoch": int(epoch),
                 "shards": [[int(s), int(e), [int(n) for n in nodes]]
                            for s, e, nodes in shards]}
         if peers:
-            topo["peers"] = [[int(i), str(h), int(p)] for i, h, p in peers]
+            topo["peers"] = [[int(p[0]), str(p[1]), int(p[2])]
+                             + ([str(p[3])] if len(p) > 3 else [])
+                             for p in peers]
+        if geo is not None:
+            topo["geo"] = geo.to_spec() if hasattr(geo, "to_spec") else geo
         self._send(contact, {"type": "epoch", "req": req, "topology": topo})
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:

@@ -133,6 +133,10 @@ class AbstractConfigurationService(ConfigurationService):
         self._specs[epoch] = install
         if install.peers:
             self.install_peers(install.peers)
+        # decoded installs are __new__ + setattr (host/wire.py), so frames
+        # from pre-geo senders simply lack the attribute
+        if getattr(install, "geo", None):
+            self.install_geo(install.geo)
         node = self.node
         if node is not None:
             node.obs.flight.record("epoch_install", None, (epoch, from_id))
@@ -144,6 +148,11 @@ class AbstractConfigurationService(ConfigurationService):
     def install_peers(self, peers) -> None:
         """Transport hook: learn addresses for nodes joining in an installed
         epoch (the TCP host merges them into its peer table)."""
+
+    def install_geo(self, geo) -> None:
+        """Transport hook: a geo placement profile arrived with an epoch
+        install (`GeoProfile.to_wire()` form); the TCP host rebuilds its
+        egress delay shim from it."""
 
     def _gossip_install(self, install, rounds: int) -> None:
         node = self.node
@@ -242,14 +251,19 @@ class LedgerConfigService(AbstractConfigurationService):
 
     FETCH_TIMEOUT_S = 2.0
 
-    def __init__(self, local_id: int, peers_hook=None):
+    def __init__(self, local_id: int, peers_hook=None, geo_hook=None):
         super().__init__(local_id)
         self._peers_hook = peers_hook
+        self._geo_hook = geo_hook
         self._fetch_rr = 0  # round-robin cursor over candidate sources
 
     def install_peers(self, peers) -> None:
         if self._peers_hook is not None:
             self._peers_hook(peers)
+
+    def install_geo(self, geo) -> None:
+        if self._geo_hook is not None:
+            self._geo_hook(geo)
 
     def fetch_topology(self, epoch: int) -> None:
         spec = self._specs.get(epoch)

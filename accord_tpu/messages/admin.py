@@ -41,26 +41,40 @@ class EpochInstall(Request):
 
     `shards` is the portable spec `((start, end, (node, ...)), ...)`;
     `peers` optionally carries transport addresses `((id, host, port), ...)`
-    so existing members learn how to reach nodes joining in this epoch.
+    — or `((id, host, port, dc), ...)` when a geo profile places the peer
+    in a named datacenter — so existing members learn how to reach (and
+    where to place) nodes joining in this epoch.  `geo` optionally carries
+    a whole placement profile in `GeoProfile.to_wire()` form so one admin
+    contact installs the latency matrix cluster-wide.
     """
 
     type = MessageType.EPOCH_INSTALL_MSG
     replay_band = -1
 
-    def __init__(self, epoch: int, shards: Tuple, peers: Optional[Tuple] = None):
+    def __init__(self, epoch: int, shards: Tuple,
+                 peers: Optional[Tuple] = None, geo=None):
         self.epoch = epoch
         self.shards = tuple(
             (int(s), int(e), tuple(int(n) for n in nodes))
             for s, e, nodes in shards)
-        self.peers = (tuple((int(i), str(h), int(p)) for i, h, p in peers)
-                      if peers else None)
+        self.peers = (tuple(
+            (int(p[0]), str(p[1]), int(p[2]))
+            + ((str(p[3]),) if len(p) > 3 and p[3] else ())
+            for p in peers) if peers else None)
+        if geo is not None:
+            from accord_tpu.topology.geo import GeoProfile
+            if not isinstance(geo, GeoProfile):
+                geo = GeoProfile.from_wire(geo)
+            self.geo = geo.to_wire()  # canonical nested tuples
+        else:
+            self.geo = None
 
     @classmethod
-    def from_topology(cls, topology, peers: Optional[Tuple] = None
-                      ) -> "EpochInstall":
+    def from_topology(cls, topology, peers: Optional[Tuple] = None,
+                      geo=None) -> "EpochInstall":
         return cls(topology.epoch,
                    tuple((s.range.start, s.range.end, s.sorted_nodes)
-                         for s in topology.shards), peers)
+                         for s in topology.shards), peers, geo=geo)
 
     def build_topology(self):
         from accord_tpu.topology.topology import Topology

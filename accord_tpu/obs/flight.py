@@ -129,6 +129,15 @@ EVENT_KINDS = {
     "qos_throttle": "submit throttled by the QoS tier — tenant token "
                     "bucket empty (qos/admission.py); data=(tenant, "
                     "priority, retry_after_us)",
+    "geo_install": "geo placement profile installed on this node "
+                   "(sim/cluster.py at build; host/tcp.py from ACCORD_GEO "
+                   "or an EpochInstall frame); data=(profile_name, dc)",
+    "dc_partition_begin": "a whole datacenter severed from the rest of "
+                          "the cluster (sim/network.py DcPartitionNemesis; "
+                          "recorded on every live node); data=(dc, "
+                          "dc_node_ids)",
+    "dc_partition_heal": "the DC partition healed (sim/network.py "
+                         "DcPartitionNemesis); data=(dc, dc_node_ids)",
 }
 
 

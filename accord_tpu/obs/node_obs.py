@@ -30,13 +30,24 @@ class NodeObs:
     """Per-node metrics registry + span store + instrumentation helpers."""
 
     __slots__ = ("node_id", "registry", "spans", "flight", "enabled",
-                 "_clock_us", "audit_view", "cpuprof")
+                 "_clock_us", "audit_view", "cpuprof", "dc", "_dc_labels")
 
     def __init__(self, node_id: int = 0, registry: Optional[Registry] = None,
                  clock_us: Optional[Callable[[], int]] = None,
                  span_capacity: int = 4096, enabled: bool = True,
-                 flight_capacity: int = 4096):
+                 flight_capacity: int = 4096, dc: Optional[str] = None,
+                 elect: Optional[str] = None):
         self.node_id = node_id
+        # geo placement attribution: when this node is assigned to a DC
+        # (topology/geo.GeoProfile), coordination counters/histograms carry
+        # dc= (and elect= in|out, electorate membership) labels so the wan
+        # report section can split fast/slow outcomes and phase latencies
+        # by coordinator placement.  With dc unset (every pre-geo harness)
+        # the label dicts are EMPTY and each metric row is byte-identical
+        # to the pre-geo shape — the obs-budget and determinism pins hold.
+        self.dc = dc
+        self._dc_labels = ({"dc": dc, "elect": elect} if dc and elect
+                           else {"dc": dc} if dc else {})
         self.registry = registry if registry is not None else Registry()
         self.spans = SpanStore(node_id, capacity=span_capacity)
         self.enabled = enabled
@@ -57,6 +68,14 @@ class NodeObs:
 
     def now_us(self) -> int:
         return int(self._clock_us())
+
+    def set_dc(self, dc: Optional[str], elect: Optional[str] = None) -> None:
+        """(Re)bind this node's geo placement labels: the TCP host learns
+        its DC only after construction (ACCORD_GEO env, or a geo profile
+        riding an EpochInstall frame)."""
+        self.dc = dc
+        self._dc_labels = ({"dc": dc, "elect": elect} if dc and elect
+                           else {"dc": dc} if dc else {})
 
     # -------------------------------------------------- coordination side --
     def txn_begin(self, txn_id, kind: Optional[str] = None,
@@ -86,7 +105,8 @@ class NodeObs:
         span = self.spans.get(tid)
         if span is not None and span.first("path") is not None:
             return
-        self.registry.counter("accord_path_total", path=which).inc()
+        self.registry.counter("accord_path_total", path=which,
+                              **self._dc_labels).inc()
         span = self.spans.event(tid, "path", self.now_us(), {"path": which})
         span.path = which
 
@@ -96,14 +116,16 @@ class NodeObs:
             return
         outcome = "ok" if failure is None else type(failure).__name__
         self.registry.counter("accord_coordinate_outcomes_total",
-                              outcome=outcome, path=path).inc()
+                              outcome=outcome, path=path,
+                              **self._dc_labels).inc()
         now = self.now_us()
         span = self.spans.event(trace_key(txn_id), "end", now,
                                 {"outcome": outcome})
         begin = span.first("begin")
         if begin is not None:
             self.registry.histogram("accord_txn_latency_us",
-                                    path=span.path or path) \
+                                    path=span.path or path,
+                                    **self._dc_labels) \
                 .observe(max(0, now - begin[0]))
         rounds = sum(1 for _, ph, _ in span.events if ph in ROUND_PHASES)
         if rounds:
@@ -115,8 +137,8 @@ class NodeObs:
         """Delta between consecutive present milestones -> per-phase
         latency histograms (first occurrence of each milestone)."""
         for ph, dur in phase_deltas(phase_firsts(span)):
-            self.registry.histogram("accord_phase_latency_us", phase=ph) \
-                .observe(dur)
+            self.registry.histogram("accord_phase_latency_us", phase=ph,
+                                    **self._dc_labels).observe(dur)
 
     # -------------------------------------------------------- replica side --
     def rx(self, trace_id: str, verb: str, from_id: int) -> None:

@@ -287,6 +287,55 @@ def _qos_section(metrics: dict) -> dict:
     }
 
 
+def _wan_section(metrics: dict) -> dict:
+    """Geo-placement attribution (topology/geo.GeoProfile): fast/slow
+    split per coordinator DC and per electorate membership (the dc=/elect=
+    labels NodeObs adds when a profile is installed), plus messages/txn
+    per link class — the slo-wan lane's recorded surface, and the
+    msgs_per_txn census doubles as the yardstick for the structural
+    message-reduction roadmap item.  Empty dict when the run is geo-free
+    (no dc-labeled counters, no link census)."""
+    per_dc: Dict[str, dict] = {}
+    per_elect: Dict[str, dict] = {}
+    for lk, v in metrics.get("counters", {}).get(
+            "accord_path_total", {}).items():
+        labels = parse_labels(lk)
+        dc = labels.get("dc")
+        if not dc:
+            continue
+        path = labels.get("path", "")
+        d = per_dc.setdefault(dc, {"fast": 0, "slow": 0})
+        d[path] = d.get(path, 0) + v
+        elect = labels.get("elect")
+        if elect:
+            e = per_elect.setdefault(elect, {"fast": 0, "slow": 0})
+            e[path] = e.get(path, 0) + v
+    for d in list(per_dc.values()) + list(per_elect.values()):
+        done = d.get("fast", 0) + d.get("slow", 0)
+        d["fast_path_ratio"] = (round(d.get("fast", 0) / done, 4)
+                                if done else None)
+    link_msgs = _counter_by_label(metrics, "accord_link_msgs_total", "cls")
+    link_bytes = _counter_by_label(metrics, "accord_link_bytes_total", "cls")
+    if not per_dc and not link_msgs and not link_bytes:
+        return {}
+    committed = sum(d.get("fast", 0) + d.get("slow", 0)
+                    for d in per_dc.values())
+    return {
+        "dcs": {dc: per_dc[dc] for dc in sorted(per_dc)},
+        "by_elect": {e: per_elect[e] for e in sorted(per_elect)},
+        "link_msgs": link_msgs,
+        "link_bytes": link_bytes,
+        "msgs_per_txn": ({cls: round(n / committed, 2)
+                          for cls, n in sorted(link_msgs.items())}
+                         if committed else {}),
+        "wan_crossings_per_txn": (round(link_msgs.get("wan", 0)
+                                        / committed, 2)
+                                  if committed else None),
+        "wan_bytes_per_txn": (round(link_bytes.get("wan", 0) / committed, 1)
+                              if committed and link_bytes else None),
+    }
+
+
 def summarize(metrics: dict, cpu: Optional[dict] = None) -> dict:
     paths = _counter_by_label(metrics, "accord_path_total", "path")
     fast = paths.get("fast", 0)
@@ -358,7 +407,20 @@ def summarize(metrics: dict, cpu: Optional[dict] = None) -> dict:
                 metrics, "accord_tcp_peer_send_drops_total"),
             "retries": _counter_total(metrics,
                                       "accord_tcp_peer_retries_total"),
+            # per-link-class census under a geo profile (topology/geo.py):
+            # msgs counted at the sim delivery / tcp flush, bytes+frames
+            # at the tcp flush with real frame sizes — WAN bytes/txn is
+            # the first-class per-txn number in the "wan" section
+            "link_msgs": _counter_by_label(metrics,
+                                           "accord_link_msgs_total", "cls"),
+            "link_bytes": _counter_by_label(metrics,
+                                            "accord_link_bytes_total",
+                                            "cls"),
+            "link_frames": _counter_by_label(metrics,
+                                             "accord_link_frames_total",
+                                             "cls"),
         },
+        "wan": _wan_section(metrics),
         "cpu": cpu_section(cpu),
         "loop": loop_section(metrics),
         "infer": _infer_section(metrics),

@@ -89,7 +89,11 @@ class BurnRun:
                  census_live_s: float = 0.0,
                  audit_kw: Optional[dict] = None,
                  corrupt_at: Optional[int] = None,
-                 corrupt_invalidated: bool = False):
+                 corrupt_invalidated: bool = False,
+                 geo=None,
+                 electorate=None,
+                 dc_partitions: bool = False,
+                 dc_partition_period_s: float = 2.0):
         if progress_log_factory == "default":
             # the progress log is a required component under message loss: an
             # acked txn whose Apply messages are all dropped is only repaired
@@ -117,7 +121,8 @@ class BurnRun:
             journal_dir=journal_dir,
             trace=trace, pipeline=pipeline,
             pipeline_config=pipeline_config,
-            qos=qos, qos_config=qos_config)
+            qos=qos, qos_config=qos_config,
+            geo=geo, electorate=electorate)
         # QoS arm: ops carry a randomized tenant (t0..t2) and priority
         # class; per-class outcomes are tallied CLIENT-side (exact across
         # crash-restarts, which reset a node's registry counters) so the
@@ -135,6 +140,18 @@ class BurnRun:
                 self.cluster.network, self.cluster.queue, self.rng.fork(),
                 list(self.cluster.nodes), period_s=partition_period_s)
             self.partition_nemesis.start()
+        # DC-partition nemesis (geo arm): periodically sever one whole
+        # datacenter and heal it — the fast-path ratio degrades while an
+        # electorate DC is dark and recovers after heal; every begin/heal
+        # lands on the flight rings (dc_partition_begin/heal)
+        self.dc_partition_nemesis = None
+        if dc_partitions:
+            assert geo is not None, "dc_partitions needs a geo profile"
+            from accord_tpu.sim.network import DcPartitionNemesis
+            self.dc_partition_nemesis = DcPartitionNemesis(
+                self.cluster.network, self.cluster.queue, self.rng.fork(),
+                geo, period_s=dc_partition_period_s)
+            self.dc_partition_nemesis.start()
         self.keys = keys
         self.concurrency = concurrency
         self.range_reads = range_reads
@@ -430,6 +447,8 @@ class BurnRun:
             self.nemesis.stop()
         if self.partition_nemesis is not None:
             self.partition_nemesis.stop()
+        if self.dc_partition_nemesis is not None:
+            self.dc_partition_nemesis.stop()
         if self.restarts:
             # a node may still be down (kill near the end of the run):
             # process virtual time until its scheduled restart lands —
@@ -610,6 +629,19 @@ def main(argv=None) -> int:
     parser.add_argument("--drop", type=float, default=0.0)
     parser.add_argument("--partitions", action="store_true",
                         help="schedule network partitions + heals")
+    parser.add_argument("--geo", action="store_true",
+                        help="place nodes on the 7-node wan3 profile "
+                             "(topology/geo.py: hub DC holding the slow "
+                             "quorum + three single-node WAN DCs at "
+                             "50/100/160ms RTT); forces --nodes 7, full "
+                             "replication")
+    parser.add_argument("--electorate", default=None, metavar="IDS",
+                        help="--geo: comma-separated node ids forming the "
+                             "fast-path electorate (default: all replicas)")
+    parser.add_argument("--dc-partitions", action="store_true",
+                        help="--geo: periodically sever one whole DC and "
+                             "heal it (DcPartitionNemesis; "
+                             "dc_partition_begin/heal flight kinds)")
     parser.add_argument("--restart", type=int, nargs="?", const=1, default=0,
                         metavar="N",
                         help="crash-restart nemesis: kill N random nodes "
@@ -731,6 +763,16 @@ def main(argv=None) -> int:
             return DelayedCommandStore.factory(RandomSource(seed ^ 0x5D5D))
         return None
 
+    geo = None
+    electorate = None
+    if args.geo:
+        from accord_tpu.topology.geo import wan3_profile
+        geo = wan3_profile()
+        args.nodes = len(geo.node_dc)
+        args.rf = None  # full replication: every shard spans every DC
+        if args.electorate:
+            electorate = frozenset(
+                int(t) for t in args.electorate.split(","))
     for i in range(args.loops):
         seed = args.seed + i
         store_factory = make_store_factory(seed)
@@ -753,7 +795,9 @@ def main(argv=None) -> int:
                       audit_live_s=args.audit_live,
                       census_live_s=args.audit_live,
                       corrupt_at=(None if args.corrupt is None
-                                  else (args.corrupt or args.ops // 2)))
+                                  else (args.corrupt or args.ops // 2)),
+                      geo=geo, electorate=electorate,
+                      dc_partitions=args.dc_partitions)
         stats = run.run()
         if args.trace:
             for node in run.cluster.nodes.values():
@@ -830,6 +874,9 @@ def main(argv=None) -> int:
                         if r["outcome"] == "agree")
             extra += (f" audit[rounds={len(run.audit_rounds)} "
                       f"agree={agree}]")
+        if run.dc_partition_nemesis is not None:
+            extra += (f" dc_partitions["
+                      f"{run.dc_partition_nemesis.partitions_applied}]")
 
         def lat(pct):
             us = stats.latency_us(pct)

@@ -106,11 +106,23 @@ class SimCluster:
                  clock_drift: bool = False, journal: bool = True,
                  journal_dir: Optional[str] = None,
                  trace: bool = False, pipeline: bool = False,
-                 pipeline_config=None, qos: bool = False, qos_config=None):
+                 pipeline_config=None, qos: bool = False, qos_config=None,
+                 geo=None, electorate=None):
         self.random = RandomSource(seed)
         self.queue = PendingQueue(self.random.fork())
         self.network = SimNetwork(self.queue, self.random.fork())
         self.scheduler = SimScheduler(self.queue)
+        # geo placement (topology/geo.GeoProfile): installs the per-link-
+        # class delay matrix into the network and DC/electorate labels
+        # into each node's obs; `electorate` (a node-id set) narrows every
+        # shard's fast-path electorate to its intersection with the
+        # shard's replicas (Shard enforces e >= rf - f).  Neither knob
+        # touches the rng fork order, so geo=None stays bit-identical to
+        # the pre-geo cluster.
+        self.geo = geo
+        self._electorate = frozenset(electorate) if electorate else None
+        if geo is not None:
+            self.network.set_geo(geo)
         # journal_dir turns the in-memory message journal into the REAL
         # write-ahead log (accord_tpu/journal/): per-node on-disk segments
         # in synchronous (deterministic) mode, enabling the crash-restart
@@ -201,8 +213,17 @@ class SimCluster:
             # clocking obs events through it would perturb the very
             # protocol behavior being observed (and mis-order stitched
             # cross-node traces)
-            obs=NodeObs(nid, clock_us=lambda: self.queue.clock.now_us),
+            obs=NodeObs(nid, clock_us=lambda: self.queue.clock.now_us,
+                        dc=self.geo.dc_of(nid) if self.geo else None,
+                        elect=("in" if nid in self._electorate else "out")
+                        if (self.geo is not None
+                            and self._electorate is not None) else None),
         )
+        if self.geo is not None:
+            # placement is forensics-relevant: a stitched timeline reading
+            # a ratio dip needs to know which DC each recorder lived in
+            node.obs.flight.record("geo_install", None,
+                                   (self.geo.name, node.obs.dc))
         if self.journal_dir is not None:
             self.journal.open_node(nid, registry=node.obs.registry,
                                    flight=node.obs.flight)
@@ -284,7 +305,10 @@ class SimCluster:
         for i in range(n_shards):
             # rotate replica sets around the ring
             replicas = [node_ids[(i + j) % len(node_ids)] for j in range(rf)]
-            shards.append(Shard(Range(i * width, (i + 1) * width), replicas))
+            electorate = (frozenset(replicas) & self._electorate
+                          if self._electorate else None)
+            shards.append(Shard(Range(i * width, (i + 1) * width), replicas,
+                                fast_path_electorate=electorate))
         return Topology(epoch, shards)
 
     def update_topology(self, topology: Topology) -> None:

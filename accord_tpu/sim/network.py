@@ -54,6 +54,15 @@ class SimNetwork:
         # drop filters: fn(from_id, to_id, message) -> True to drop
         # (reference test/accord/NetworkFilter)
         self.filters: list = []
+        # geo placement (topology/geo.GeoProfile): when installed, the
+        # per-(src,dst) link-class bounds replace the flat default-link
+        # delay draw — still exactly one bounded next_int per delivery, so
+        # the run stays deterministic per seed; explicit set_link overrides
+        # (nemesis partitions, bespoke test links) still win
+        self.geo = None
+
+    def set_geo(self, profile) -> None:
+        self.geo = profile
 
     def add_filter(self, fn: Callable) -> Callable:
         self.filters.append(fn)
@@ -99,6 +108,32 @@ class SimNetwork:
             obs.flight.record("drop", getattr(message, "trace_id", None),
                               (from_id, to_id, msg_name))
 
+    def _delay_us(self, from_id: int, to_id: int, link: LinkConfig) -> int:
+        """Per-delivery delay draw.  With a geo profile installed and no
+        explicit link override for this pair, the (src,dst) link-class
+        bounds govern; otherwise the link's own bounds (the pre-geo flat
+        path, bit-identical in rng consumption)."""
+        if self.geo is not None and (from_id, to_id) not in self.links:
+            bounds = self.geo.delay_bounds_us(from_id, to_id)
+            if bounds is not None:
+                lo, hi = bounds
+                return lo if hi <= lo else self.random.next_int(lo, hi)
+        return (link.min_delay_us
+                if link.max_delay_us <= link.min_delay_us
+                else self.random.next_int(link.min_delay_us, link.max_delay_us))
+
+    def _count_link_class(self, from_id: int, to_id: int) -> None:
+        """Per-link-class message census on the SENDER's registry — the
+        messages/txn x link-class yardstick (WAN crossings/txn) the wan
+        report section folds.  Only active under a geo profile."""
+        cls = self.geo.link_class(from_id, to_id)
+        if cls is None:
+            return
+        node = self.nodes.get(from_id)
+        obs = getattr(node, "obs", None)
+        if obs is not None:
+            obs.registry.counter("accord_link_msgs_total", cls=cls).inc()
+
     def deliver_request(self, from_id: int, to_id: int, request: Request,
                         reply_context) -> None:
         link = self.link(from_id, to_id)
@@ -109,9 +144,9 @@ class SimNetwork:
             self._record_drop(from_id, to_id, request, msg_name)
             return
         self._count(f"deliver.{msg_name}")
-        delay = (link.min_delay_us
-                 if link.max_delay_us <= link.min_delay_us
-                 else self.random.next_int(link.min_delay_us, link.max_delay_us))
+        if self.geo is not None:
+            self._count_link_class(from_id, to_id)
+        delay = self._delay_us(from_id, to_id, link)
 
         def run():
             node = self.nodes.get(to_id)
@@ -132,9 +167,9 @@ class SimNetwork:
             self._record_drop(from_id, to_id, reply, type(reply).__name__)
             return
         self._count(f"deliver.{type(reply).__name__}")
-        delay = (link.min_delay_us
-                 if link.max_delay_us <= link.min_delay_us
-                 else self.random.next_int(link.min_delay_us, link.max_delay_us))
+        if self.geo is not None:
+            self._count_link_class(from_id, to_id)
+        delay = self._delay_us(from_id, to_id, link)
 
         def run():
             node = self.nodes.get(to_id)
@@ -191,6 +226,77 @@ class PartitionNemesis:
             self.network.partition(ids[:cut], ids[cut:])
             self.partitioned = True
             self.partitions_applied += 1
+        self.queue.add(self.random.next_int(1, self.max_partition_us),
+                       self._tick)
+
+
+class DcPartitionNemesis:
+    """Periodically severs ONE whole datacenter from the rest of the
+    cluster and heals it (virtual-time ticks like PartitionNemesis, which
+    cuts random groups).  Every begin/heal is recorded on each live node's
+    flight ring (`dc_partition_begin` / `dc_partition_heal`) so a stitched
+    timeline explains exactly when and why the fast-path ratio dipped: a
+    partitioned electorate member makes the fast quorum unreachable while
+    a hub-local slow quorum keeps committing on the slow path.
+
+    `partition_now(dc)` / `heal_now()` are public so a bench lane can
+    drive deterministic degrade/heal windows without the random ticker."""
+
+    def __init__(self, network: SimNetwork, queue: PendingQueue,
+                 random: RandomSource, geo, dcs=None,
+                 period_s: float = 5.0, max_partition_s: float = 4.0):
+        self.network = network
+        self.queue = queue
+        self.random = random
+        self.geo = geo
+        # DCs eligible for partitioning (default: every named DC)
+        self.dcs = sorted(dcs) if dcs else sorted(geo.dcs)
+        self.period_us = int(period_s * 1e6)
+        self.max_partition_us = int(max_partition_s * 1e6)
+        self.partitioned_dc: str = ""
+        self.partitions_applied = 0
+        self._stopped = False
+
+    def start(self) -> None:
+        self.queue.add(self.random.next_int(0, self.period_us), self._tick)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self.partitioned_dc:
+            self.heal_now()
+
+    def partition_now(self, dc: str) -> None:
+        inside = self.geo.nodes_in(dc)
+        outside = [n for n in self.network.nodes if n not in inside]
+        self.network.partition(inside, outside)
+        self.partitioned_dc = dc
+        self.partitions_applied += 1
+        data = (dc, tuple(inside))
+        for obs in self._all_obs():
+            obs.flight.record("dc_partition_begin", None, data)
+
+    def heal_now(self) -> None:
+        dc, self.partitioned_dc = self.partitioned_dc, ""
+        self.network.heal()
+        data = (dc, tuple(self.geo.nodes_in(dc)))
+        for obs in self._all_obs():
+            obs.flight.record("dc_partition_heal", None, data)
+
+    def _all_obs(self):
+        return [obs for obs in
+                (getattr(node, "obs", None)
+                 for node in self.network.nodes.values())
+                if obs is not None]
+
+    def _tick(self) -> None:
+        if self._stopped:
+            return
+        if self.partitioned_dc:
+            self.heal_now()
+            self.queue.add(self.random.next_int(1, self.period_us),
+                           self._tick)
+            return
+        self.partition_now(self.dcs[self.random.next_int(len(self.dcs))])
         self.queue.add(self.random.next_int(1, self.max_partition_us),
                        self._tick)
 

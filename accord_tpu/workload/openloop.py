@@ -199,6 +199,132 @@ def run_open_loop_sim(profile: str = "zipfian", ops: int = 400,
     return result
 
 
+# ------------------------------------------------------------- wan lane ----
+
+class WanRec(OpRecord):
+    """OpRecord + the decided commit path (fast|slow) for windowed
+    fast-path-ratio measurement."""
+
+    __slots__ = ("path",)
+
+    def __init__(self, idx: int, intended_us: int):
+        super().__init__(idx, intended_us)
+        self.path: Optional[str] = None
+
+
+def wan_window_ratios(records: List["WanRec"], t0_us: int,
+                      begin_us: int, end_us: int) -> Dict[str, dict]:
+    """Fast-path ratio split into before/during/after a [begin, end)
+    virtual-time window (offsets from t0): the degrade-then-recover
+    surface of the DC-partition arm.  Ops are bucketed by SUBMIT time —
+    an op submitted during the window pays the partition regardless of
+    when it finally settles."""
+    out = {}
+    for name, lo, hi in (("before", 0, begin_us),
+                         ("during", begin_us, end_us),
+                         ("after", end_us, None)):
+        recs = [r for r in records
+                if r.submit_us is not None
+                and r.submit_us - t0_us >= lo
+                and (hi is None or r.submit_us - t0_us < hi)]
+        fast = sum(1 for r in recs if r.path == "fast")
+        slow = sum(1 for r in recs if r.path == "slow")
+        out[name] = {"ops": len(recs), "fast": fast, "slow": slow,
+                     "fast_path_ratio": (round(fast / (fast + slow), 4)
+                                         if fast + slow else None)}
+    return out
+
+
+def run_wan_sim(electorate=None, origin: int = 1, ops: int = 200,
+                rate_per_s: float = 30.0, schedule: str = "poisson",
+                seed: int = 0, hub: int = 4, keys: int = 240,
+                n_shards: int = 2, profile: str = "uniform",
+                geo=None, partition=None,
+                keep_cluster: bool = False) -> OpenLoopResult:
+    """Deterministic open-loop WAN scenario: a geo-placed sim cluster
+    (default topology/geo.wan3_profile — a hub DC holding the full slow
+    quorum plus three single-node DCs at 50/100/160 ms RTT) driven from a
+    PINNED origin node, so one run measures one (electorate, coordinator
+    placement) configuration.  `electorate` narrows every shard's
+    fast-path electorate (None = all replicas); latencies are virtual
+    microseconds against the profile's injected matrix, and each acked
+    op records its decided commit path (WanRec.path) so fast-path ratio
+    can be windowed.
+
+    partition: optional (dc, begin_us, end_us) — sever that whole DC for
+    [begin, end) after t0 via DcPartitionNemesis.partition_now/heal_now,
+    the deterministic degrade-then-recover arm (flight kinds
+    dc_partition_begin/heal mark the window on every node's ring)."""
+    from accord_tpu.sim.cluster import SimCluster
+    from accord_tpu.sim.network import DcPartitionNemesis
+    from accord_tpu.topology.geo import wan3_profile
+
+    if geo is None:
+        geo = wan3_profile(hub)
+    nodes = len(geo.node_dc)
+    rng = RandomSource(seed)
+    cluster = SimCluster(n_nodes=nodes, seed=rng.next_long(),
+                         n_shards=n_shards, rf=nodes, geo=geo,
+                         electorate=electorate)
+    prof = make_profile(profile, keys=keys, seed=rng.next_long())
+    offsets = make_offsets_us(schedule, rate_per_s, ops,
+                              seed=rng.next_long())
+    t0_us = cluster.queue.clock.now_us
+    records = [WanRec(i, t0_us + off) for i, off in enumerate(offsets)]
+    ops_list = [prof.next_op() for _ in range(ops)]
+    settled = [0]
+    nemesis = None
+    if partition is not None:
+        dc, begin_us, end_us = partition
+        nemesis = DcPartitionNemesis(cluster.network, cluster.queue,
+                                     rng.fork(), geo)
+        cluster.queue.add(begin_us, lambda: nemesis.partition_now(dc))
+        cluster.queue.add(end_us, nemesis.heal_now)
+
+    def submit(i: int) -> None:
+        rec = records[i]
+        rec.submit_us = cluster.queue.clock.now_us
+        txn = build_txn(ops_list[i])
+
+        def done(value, failure):
+            rec.end_us = cluster.queue.clock.now_us
+            settled[0] += 1
+            if failure is not None or value is None:
+                rec.outcome = "fail"
+                return
+            rec.outcome = "ack"
+            from accord_tpu.obs.spans import phase_firsts, trace_key
+            span = cluster.nodes[origin].obs.spans.get(
+                trace_key(value.txn_id))
+            if span is not None:
+                rec.phase_firsts = phase_firsts(span)
+                rec.path = span.path
+
+        cluster.node(origin).coordinate(txn).add_callback(done)
+
+    for i, off in enumerate(offsets):
+        cluster.queue.add(off, (lambda j: (lambda: submit(j)))(i))
+    cluster.process_until(lambda: settled[0] >= ops, max_items=50_000_000)
+
+    summary = cluster.metrics_snapshot()["summary"]
+    sched = {"kind": schedule, "rate_per_s": rate_per_s, "ops": ops,
+             "seed": seed, "host": "sim-wan", "origin": origin,
+             "origin_dc": geo.dc_of(origin),
+             "electorate": sorted(electorate) if electorate else None}
+    report = _collect(records, rate_per_s, sched, summary, t0_us)
+    if partition is not None:
+        dc, begin_us, end_us = partition
+        report["partition"] = {"dc": dc, "begin_us": begin_us,
+                               "end_us": end_us,
+                               "windows": wan_window_ratios(
+                                   records, t0_us, begin_us, end_us)}
+    result = OpenLoopResult(records, report, summary, sched)
+    result.geo = geo
+    if keep_cluster:
+        result.cluster = cluster
+    return result
+
+
 # ------------------------------------------------------------- tcp host ----
 
 def run_open_loop_tcp(profile: str = "zipfian", ops: int = 300,

@@ -482,3 +482,86 @@ def test_overload_guard_dry_run_rejects_broken_rows(tmp_path):
                 {"ACCORD_BENCH_HISTORY": str(hist)})
     assert proc.returncode != 0
     assert "blew out" in (proc.stderr + proc.stdout)
+
+
+# --------------------------------- multi-DC WAN lane (ISSUE 17) --
+
+def test_wan_guard_dry_run_validates_wan_row_schema():
+    """The recorded slo-wan row must stay guard-parseable AND carry the
+    one-WAN-RTT verdicts the lane exists for: every sweep arm's fast-path
+    ratio and open-loop p50/p99 expressed as multiples of the injected
+    WAN RTT, WAN crossings/txn from the link-class census, per-DC
+    attribution, the degrade-then-recover partition windows with a green
+    audit, and the flat tcp lane's messages/txn baseline for ROADMAP's
+    message-reduction yardstick — on the exact-sample quantile path like
+    every SLO lane."""
+    proc = _run(["--config", "slo-wan", "--guard", "--dry-run"])
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "slo-wan_guard" and row["dry_run"] is True
+    assert row["baselines"], "no slo-wan baseline in BENCH_HISTORY.json"
+    assert row["baselines"][0]["slo_open_p99_us"] > 0
+    hist = json.load(open(os.path.join(REPO, "BENCH_HISTORY.json")))
+    slo = hist["slo-wan"]["host"]["slo"]
+    assert slo["quantile_source"] == "exact-sample"
+    wan = slo["wan"]
+    assert wan["rtt_us"] > 0
+    arms = {a["config"]: a for a in wan["sweep"]}
+    head = arms[wan["headline_config"]]
+    # the paper's signature property, as recorded: minimal electorate +
+    # coordinator inside it commits in ~one WAN round trip on the fast
+    # path; widening the electorate or moving the coordinator out pays
+    assert head["fast_path_ratio"] >= 0.8, head
+    assert head["p50_rtt_multiple"] <= 1.2, head
+    assert head["wan_crossings_per_txn"] > 0
+    assert head["dcs"], "per-DC attribution missing from headline arm"
+    for other in wan["sweep"]:
+        if other["config"] != wan["headline_config"]:
+            assert other["p50_rtt_multiple"] \
+                >= head["p50_rtt_multiple"] + 0.4, (head, other)
+    ws = wan["partition"]["windows"]
+    assert ws["before"]["fast_path_ratio"] >= 0.8, ws
+    assert ws["during"]["fast_path_ratio"] < 0.5, ws
+    assert ws["after"]["fast_path_ratio"] >= 0.8, ws
+    assert wan["partition"]["audit"]["agree"] is True
+    assert wan["partition"]["lost_acks"] == 0
+    flat = wan["flat_tcp_baseline"]
+    assert flat and flat["msgs_per_txn"] > 0
+
+
+def test_wan_guard_dry_run_rejects_broken_rows(tmp_path):
+    """A slo-wan row missing the headline fast-path ratio, not expressing
+    p99 as an RTT multiple, claiming non-exact quantile provenance, or
+    carrying a diverged partition arm must fail the dry run — a broken
+    WAN baseline must fail CI, not silently keep gating."""
+    good = json.load(open(os.path.join(REPO, "BENCH_HISTORY.json")))
+    hist = tmp_path / "hist.json"
+
+    def _reject(mutate, needle):
+        lane = json.loads(json.dumps(good["slo-wan"]))  # deep copy
+        mutate(lane["host"]["slo"])
+        hist.write_text(json.dumps({"slo-wan": lane}))
+        proc = _run(["--config", "slo-wan", "--guard", "--dry-run"],
+                    {"ACCORD_BENCH_HISTORY": str(hist)})
+        assert proc.returncode != 0, needle
+        assert needle in (proc.stderr + proc.stdout), \
+            (needle, proc.stderr[-500:])
+
+    def _head(slo):
+        wan = slo["wan"]
+        return next(a for a in wan["sweep"]
+                    if a["config"] == wan["headline_config"])
+
+    _reject(lambda slo: _head(slo).__setitem__("fast_path_ratio", None),
+            "fast_path_ratio broken")
+    _reject(lambda slo: _head(slo).pop("fast_path_ratio"),
+            "missing fast_path_ratio")
+    _reject(lambda slo: _head(slo).__setitem__("p99_rtt_multiple",
+                                               "55204us"),
+            "not an RTT multiple")
+    _reject(lambda slo: slo.__setitem__("quantile_source", "log2-bucket"),
+            "exact-sample")
+    _reject(lambda slo: slo["wan"]["partition"]["audit"]
+            .__setitem__("agree", False), "audit divergence")
+    _reject(lambda slo: slo["wan"]["partition"]
+            .__setitem__("lost_acks", 2), "lost acks")

@@ -1,0 +1,171 @@
+"""Multi-DC WAN scenario tests (topology/geo.GeoProfile + the geo-placed
+sim path).
+
+Three contracts pinned here:
+
+  1. GeoProfile itself — placement, link classes, latency bounds, the
+     RTT arithmetic lanes cite, and lossless spec/wire round-trips.
+  2. Determinism — the same seed with the same GeoProfile is
+     bit-identical (burn end states, audit digests, WAN run ledgers),
+     and the DEFAULT no-profile path is unperturbed by the geo plumbing:
+     explicitly passing the new kwargs at their defaults reproduces the
+     plain call bit-for-bit (the differential guarantee PR 12/16 set the
+     precedent for — a feature off must not move a single rng draw).
+  3. The DC-partition nemesis — fast-path ratio degrades while an
+     electorate DC is dark and recovers after heal, in both the
+     deterministic open-loop arm and the randomized burn arm, with the
+     burn's verifier/audit/journal checkers staying green and the
+     begin/heal flight kinds on every node's ring.
+"""
+
+import pytest
+
+from accord_tpu.topology.geo import (DEFAULT_CLASS_BOUNDS_US, GeoProfile,
+                                     wan3_profile)
+
+
+class TestGeoProfile:
+    def test_placement_and_link_classes(self):
+        geo = wan3_profile(hub=4)
+        assert geo.nodes_in("dc_a") == (1, 2, 3, 4)
+        assert geo.dc_of(5) == "dc_b" and geo.dc_of(7) == "dc_d"
+        assert geo.dc_of(99) is None
+        assert geo.link_class(1, 2) == "intra"
+        assert geo.link_class(1, 5) == "wan"
+        assert geo.link_class(99, 1) is None, \
+            "unplaced endpoints must fall back to flat behavior"
+
+    def test_delay_bounds_and_rtt(self):
+        geo = wan3_profile(hub=4)
+        assert geo.delay_bounds_us(1, 2) == DEFAULT_CLASS_BOUNDS_US["intra"]
+        assert geo.delay_bounds_us(1, 5) == (22_500, 27_500)
+        assert geo.delay_bounds_us(4, 6) == (45_000, 55_000)
+        assert geo.delay_bounds_us(0, 5) is None
+        # the injected RTT a lane's p50_rtt_multiple is expressed against:
+        # 2x the midpoint one-way delay, symmetric in its arguments
+        assert geo.rtt_us("dc_a", "dc_b") == 50_000
+        assert geo.rtt_us("dc_b", "dc_a") == 50_000
+        assert geo.rtt_us("dc_a", "dc_c") == 100_000
+        assert geo.rtt_us("dc_a", "dc_d") == 160_000
+        assert geo.one_way_nominal_us(1, 5) == 25_000
+
+    def test_metro_class_and_unlisted_pair_default(self):
+        geo = GeoProfile({"x": (1,), "y": (2,), "z": (3,)},
+                         pairs=[("x", "y", "metro")])
+        assert geo.link_class(1, 2) == "metro"
+        assert geo.delay_bounds_us(1, 2) == DEFAULT_CLASS_BOUNDS_US["metro"]
+        # unlisted cross-DC pairs default to class wan
+        assert geo.link_class(1, 3) == "wan"
+        assert geo.delay_bounds_us(2, 3) == DEFAULT_CLASS_BOUNDS_US["wan"]
+
+    def test_spec_and_wire_roundtrips(self):
+        import json
+        geo = wan3_profile(hub=3)
+        assert GeoProfile.from_spec(geo.to_spec()) == geo
+        assert GeoProfile.from_wire(geo.to_wire()) == geo
+        # the ACCORD_GEO env payload is the JSON spec
+        assert GeoProfile.from_env(json.dumps(geo.to_spec())) == geo
+        assert GeoProfile.from_env(None) is None
+        assert GeoProfile.from_env("") is None
+
+    def test_duplicate_node_placement_rejected(self):
+        with pytest.raises(ValueError, match="both"):
+            GeoProfile({"a": (1, 2), "b": (2, 3)})
+
+
+class TestGeoDeterminism:
+    def test_wan_sim_same_seed_same_profile_identical(self):
+        from accord_tpu.workload.openloop import run_wan_sim
+
+        def ledger():
+            run = run_wan_sim(electorate=frozenset({1, 2, 3, 5}),
+                              origin=1, ops=40, rate_per_s=40.0, seed=11)
+            assert run.counts.get("fail", 0) == 0, run.counts
+            return ([(r.submit_us, r.end_us, r.outcome, r.path)
+                     for r in run.records],
+                    run.summary["wan"])
+
+        l1, w1 = ledger()
+        l2, w2 = ledger()
+        assert l1 == l2, "WAN run ledger diverged across identical seeds"
+        assert w1 == w2, "wan summary section diverged"
+        assert any(path == "fast" for _, _, _, path in l1)
+
+    def test_burn_same_seed_same_profile_identical(self):
+        from accord_tpu.sim.burn import BurnRun
+
+        def arm():
+            r = BurnRun(41, ops=40, nodes=7, keys=12, rf=None,
+                        geo=wan3_profile(),
+                        electorate=frozenset({1, 2, 3, 5}))
+            stats = r.run()
+            snaps = {n: r.cluster.node(n).data_store.snapshot()
+                     for n in r.cluster.nodes}
+            return ((stats.acks, stats.nacks, stats.shed, stats.lost,
+                     stats.pending), snaps, r.audit_rounds)
+
+        s1, snaps1, audit1 = arm()
+        s2, snaps2, audit2 = arm()
+        assert s1 == s2, (s1, s2)
+        assert snaps1 == snaps2, "replica state diverged under geo"
+        assert audit1 == audit2, "audit digests diverged under geo"
+        assert s1[0] > 0 and s1[3] == 0, s1
+
+    def test_default_no_profile_path_unperturbed(self):
+        """BurnRun with the geo kwargs at their explicit defaults must be
+        bit-identical to the plain pre-PR call shape — geo plumbing that
+        is off may not consume one rng draw or move one event."""
+        from accord_tpu.sim.burn import BurnRun
+
+        def arm(**kw):
+            r = BurnRun(23, ops=60, nodes=3, keys=10, **kw)
+            stats = r.run()
+            snaps = {n: r.cluster.node(n).data_store.snapshot()
+                     for n in r.cluster.nodes}
+            return ((stats.acks, stats.nacks, stats.shed, stats.lost),
+                    snaps, r.audit_rounds, r.cluster.queue.processed)
+
+        plain = arm()
+        explicit = arm(geo=None, electorate=None, dc_partitions=False)
+        assert plain == explicit, \
+            "defaulted geo kwargs perturbed the no-profile world"
+
+
+class TestDcPartitionNemesis:
+    def test_degrade_then_recover_windows(self):
+        """Deterministic open-loop arm: sever dc_b (an electorate member)
+        for a mid-run window — the fast-path ratio must collapse during
+        the window and recover after heal, with every op still settling."""
+        from accord_tpu.workload.openloop import run_wan_sim
+
+        ops, rate = 150, 30.0
+        dur_us = int(ops / rate * 1e6)
+        begin_us, end_us = int(0.25 * dur_us), int(0.66 * dur_us)
+        run = run_wan_sim(electorate=frozenset({1, 2, 3, 5}), origin=1,
+                          ops=ops, rate_per_s=rate, seed=30,
+                          partition=("dc_b", begin_us, end_us))
+        assert run.counts.get("fail", 0) == 0, run.counts
+        ws = run.report["partition"]["windows"]
+        assert all(ws[w]["ops"] > 0 for w in ("before", "during", "after"))
+        assert ws["before"]["fast_path_ratio"] >= 0.8, ws
+        assert ws["during"]["fast_path_ratio"] < 0.5, ws
+        assert ws["after"]["fast_path_ratio"] >= 0.8, ws
+
+    def test_burn_dc_partition_arm(self):
+        """Randomized burn arm: the DC-partition nemesis fires under the
+        full checker stack (verifiers, end-of-run audit, journal
+        validation all run inside BurnRun.run) and every node's flight
+        ring carries the begin/heal markers."""
+        from accord_tpu.sim.burn import BurnRun
+
+        r = BurnRun(19, ops=60, nodes=7, keys=12, rf=None,
+                    geo=wan3_profile(),
+                    electorate=frozenset({1, 2, 3, 5}),
+                    dc_partitions=True, dc_partition_period_s=1.0)
+        stats = r.run()
+        assert r.dc_partition_nemesis.partitions_applied > 0
+        assert stats.acks > 0 and stats.lost == 0, stats
+        kinds = {e[2] for n in r.cluster.nodes
+                 for e in r.cluster.node(n).obs.flight.events}
+        assert "dc_partition_begin" in kinds
+        assert "dc_partition_heal" in kinds

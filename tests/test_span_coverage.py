@@ -114,6 +114,26 @@ def test_elasticity_kinds_are_covered():
         assert kind in recorded, f"nothing records {kind}"
 
 
+def test_geo_kinds_are_covered():
+    """The multi-DC geo layer's forensics hooks must stay on the ring:
+    a profile landing on a node (`geo_install` — sim cluster build AND
+    the TCP host's env/EpochInstall path, stamped with the profile name
+    and the node's DC) and the DC-partition nemesis marking its sever/
+    heal window on every live node (`dc_partition_begin`/`heal`) so a
+    stitched timeline explains exactly when and why the fast-path ratio
+    dipped.  Pinned as a SET like the journal lifecycle below, so a hook
+    cannot vanish together with its EVENT_KINDS row."""
+    recorded = _recorded_flight_kinds()
+    for kind, prefixes in (("geo_install", ("sim", "host")),
+                           ("dc_partition_begin", ("sim",)),
+                           ("dc_partition_heal", ("sim",))):
+        assert kind in EVENT_KINDS, f"{kind} missing from EVENT_KINDS"
+        assert kind in recorded, f"nothing records {kind}"
+        for prefix in prefixes:
+            assert any(p.startswith(prefix) for p in recorded[kind]), \
+                (kind, prefix, recorded[kind])
+
+
 def test_paging_kinds_are_covered():
     """The bounded-memory paging tier's forensics hooks must stay on the
     ring: each eviction to the spill store (`cmd_evict`), each fault back

@@ -295,6 +295,8 @@ def _synthesize_admin(gen: _Gen):
     from accord_tpu.primitives.keys import Key
     from accord_tpu.primitives.timestamp import Domain, TxnKind
 
+    from accord_tpu.topology.geo import wan3_profile
+
     epoch = 2 + gen.rng.next_int(0, 5)
     mid = 100 + gen.token()
     install = EpochInstall(
@@ -302,9 +304,20 @@ def _synthesize_admin(gen: _Gen):
         ((0, mid, (1, 2, 3)), (mid, 1000, (2, 3, 4))),
         peers=((4, "127.0.0.1", 10_000 + gen.rng.next_int(0, 50_000)),))
     fence = gen.txn_id(kind=TxnKind.EXCLUSIVE_SYNC_POINT, domain=Domain.RANGE)
+    geo = wan3_profile(hub=1 + gen.rng.next_int(0, 4))
     return [
         install,
         EpochInstall(epoch, ((0, 1000, (1, 2)),)),  # peers=None arm
+        # geo arm: a whole placement profile rides the install, and peer
+        # specs carry the optional 4th dc element (host/tcp.py merges both)
+        EpochInstall(
+            epoch, ((0, 1000, tuple(sorted(geo.node_dc))),),
+            peers=tuple(
+                (nid, "127.0.0.1", 10_000 + nid, geo.dc_of(nid))
+                for nid in sorted(geo.node_dc)),
+            geo=geo),
+        EpochInstall(epoch, ((0, 1000, (1, 2)),),
+                     geo=geo.to_wire()),  # wire-form geo input arm
         TopologyFetchReq(epoch),
         TopologyFetchOk(install),
         TopologyFetchNack(epoch),
