@@ -40,6 +40,8 @@ DEFAULT_ROOTS = (
     "accord_tpu.host.tcp::TcpHost._dispatch",
     "accord_tpu.host.maelstrom::MaelstromHost.run",
     "accord_tpu.local.node::Node._process",
+    "accord_tpu.shard.worker::WorkerHost.run",
+    "accord_tpu.shard.worker::WorkerHost._dispatch",
 )
 
 # (function qualname, primitive) pairs that are the loop's own idle wait
@@ -48,6 +50,10 @@ ALLOWED: Dict[Tuple[str, str], str] = {
     ("accord_tpu.host.maelstrom::MaelstromHost.run", "queue.Queue.get"):
         "the Maelstrom loop's own poll: stdin lines arrive via the reader "
         "thread's queue, and this get(timeout=) IS the scheduler block",
+    ("accord_tpu.shard.worker::WorkerHost.run", "queue.Queue.get"):
+        "the shard worker loop's own poll: pipe frames arrive via the "
+        "reader thread's queue, and this get(timeout=) IS the scheduler "
+        "block",
 }
 
 

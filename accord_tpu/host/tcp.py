@@ -631,6 +631,27 @@ class TcpHost:
         from accord_tpu.obs.cpuprof import LoopHealth
         self.loop_health = LoopHealth(self.node.obs.registry, self.flight)
         self.scheduler.lag_observer = self.loop_health.timer_lag
+        # ACCORD_SHARDS=<n> (n >= 2): per-shard worker runtime (shard/) —
+        # the node's command stores live in n forked worker processes, one
+        # event loop + one store + one WAL band each, and this host's
+        # command_stores becomes the supervisor-side router.  Unset or 1:
+        # the in-loop CommandStores built by Node above is untouched —
+        # bit-identical to the pre-shard wiring.  The swap happens BEFORE
+        # report_topology below so the genesis install drives spawn_all().
+        from accord_tpu import shard as _shard
+        self.shard_supervisor = None
+        _n_workers = _shard.workers_from_env()
+        if _n_workers:
+            from accord_tpu.shard.supervisor import (ShardSupervisor,
+                                                     WorkerCommandStores)
+            self.shard_supervisor = ShardSupervisor(self, self.node,
+                                                    _n_workers)
+            self.node.command_stores = WorkerCommandStores(
+                self.node, self.shard_supervisor)
+            # HLC striping: parent mints stripe 0, worker k stripe k+1,
+            # all mod n+1 — timestamps stay unique across the processes
+            # sharing this node id without coordination
+            self.node.set_hlc_stripe(0, _n_workers + 1)
         # topology flows through a real ConfigurationService (the admin
         # plane's epoch ledger): installs gossip peer-to-peer, gaps heal
         # via TOPOLOGY_FETCH, and `peers` specs riding an install teach
@@ -673,7 +694,8 @@ class TcpHost:
         self.qos = qos_tier_from_env(
             self.node.obs.registry, self.flight,
             clock_us=lambda: time.time_ns() // 1000,
-            loop_health=self.loop_health, wal=self.wal)
+            loop_health=self.loop_health, wal=self.wal,
+            n_shards=_n_workers)
         if self.qos is not None:
             lh_hook, qos_hook = self.loop_health.timer_lag, self.qos.observe_lag
 
@@ -1083,6 +1105,18 @@ class TcpHost:
                                     "node": self.my_id,
                                     "topology": self._topology_spec()})
             return
+        if kind == "shards":
+            # shard-worker runtime view: per-worker pid/generation/live
+            # rows from the supervisor (empty when in-loop); the crash
+            # nemesis uses the pids to aim its SIGKILL
+            if from_id <= 0:
+                sup = self.shard_supervisor
+                self.emit(from_id, {"type": "shards_reply",
+                                    "req": body.get("req"),
+                                    "node": self.my_id,
+                                    "shards": (sup.admin_view()
+                                               if sup is not None else [])})
+            return
         if kind == "drain":
             # admin plane: scale-in — fence, hand off, wait durability,
             # retire without losing an ack
@@ -1244,9 +1278,19 @@ class TcpHost:
         if self.qos is not None:
             # QoS outer ring: admission BEFORE journal append/coordination
             # state is spent — the nack is retriable by construction and
-            # carries the backoff hint the client honors
+            # carries the backoff hint the client honors.  Under the
+            # worker runtime the submit is also charged against its home
+            # shard's (tenant, shard) sub-bucket — the shard the router
+            # would dispatch it to, derived from the same key set
+            shard = None
+            if self.qos.n_shards:
+                toks = (set(body.get("reads", []))
+                        | {int(t) for t in body.get("appends", {})})
+                if toks:
+                    shard = self.node.command_stores.shard_of(Keys.of(*toks))
             nack = self.qos.admit(str(body.get("tenant") or ""),
-                                  str(body.get("priority") or "normal"))
+                                  str(body.get("priority") or "normal"),
+                                  shard=shard)
             if nack is not None:
                 self.emit(from_id, {"type": "submit_reply", "req": req,
                                     "ok": False, "error": repr(nack),
@@ -1322,6 +1366,11 @@ class TcpHost:
         self._wakeup()
         if self.auditor is not None:
             self.auditor.stop()
+        if self.shard_supervisor is not None:
+            try:
+                self.shard_supervisor.close()  # retire workers: final
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass           # fsync per band rides ShardRetire
         if self.wal is not None:
             try:
                 self.wal.close()  # final fsync: nothing acked is lost

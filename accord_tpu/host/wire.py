@@ -59,6 +59,9 @@ _MODULES = [
     # wire (retry_after_us/tenant/priority re-attached via wire_extra)
     "accord_tpu.qos.admission",
     "accord_tpu.utils.interval_map",
+    # worker-pipe frames for the per-shard runtime (shard/): the
+    # supervisor<->worker duplex pipe speaks the same codec as the network
+    "accord_tpu.shard.frames",
 ]
 
 _CLASSES: Dict[str, Type] = {}

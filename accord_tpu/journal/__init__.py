@@ -40,17 +40,24 @@ def journal_env_dir() -> str:
     return os.environ.get("ACCORD_JOURNAL", "")
 
 
-def attach_journal_from_env(node):
+def attach_journal_from_env(node, band: str = None):
     """Host-side wiring: when ACCORD_JOURNAL=<dir> is set, open (or create)
     this node's journal under <dir>/node-<id>, replay any surviving state
     into the freshly built node, attach the WAL as `node.journal` (every
     has_side_effects request is appended by Node._process before the ack),
     and — when group commit is on — gate outbound replies on the fsync
-    watermark with DurableAckSink.  Returns the WAL, or None when off."""
+    watermark with DurableAckSink.  Returns the WAL, or None when off.
+
+    `band` names a sub-journal under the node's directory: the shard worker
+    runtime journals where it processes, so each worker owns the WAL band
+    <dir>/node-<id>/<band> and replays exactly its own shard's history on
+    respawn while the parent keeps the node-plane band at the root."""
     base = journal_env_dir()
     if not base:
         return None
     path = os.path.join(base, f"node-{node.id}")
+    if band:
+        path = os.path.join(path, band)
     cfg = JournalConfig.from_env(path)
     wal = WriteAheadLog(path, node_id=node.id, config=cfg,
                         registry=node.obs.registry, flight=node.obs.flight,

@@ -91,6 +91,29 @@ def _in_ranges(parts, ranges: Ranges) -> bool:
     return any(ranges.contains(k) for k in parts)
 
 
+def _owns_min_token(owned: Ranges, parts, ranges: Ranges) -> bool:
+    """Worker-runtime dedup (shard/): does `owned` cover the MINIMAL token
+    of `parts` ∩ `ranges`?  The in-process walk dedups a cross-store txn
+    with a `seen` set, but per-shard worker processes cannot share one —
+    instead every worker applies this filter, and since exactly one worker
+    owns any given token, each txn contributes exactly one leaf node-wide
+    (XOR folds would cancel pairwise on double-count)."""
+    if parts is None:
+        return False
+    best = None
+    if isinstance(parts, Ranges):
+        for a in parts:
+            for b in ranges:
+                s = max(a.start, b.start)
+                if s < min(a.end, b.end) and (best is None or s < best):
+                    best = s
+    else:
+        for k in parts:
+            if ranges.contains(k) and (best is None or k.token < best):
+                best = k.token
+    return best is not None and owned.contains_token(best)
+
+
 def entry_class(cmd) -> Optional[Tuple[str, Optional[Timestamp]]]:
     """Auditable decision of a command, or None when undecided.
 
@@ -129,10 +152,13 @@ def node_floors(node, ranges: Ranges) -> Tuple[Timestamp, Timestamp]:
     return lo, (hi if hi is not None else TXNID_NONE)
 
 
-def _walk_decided(node, ranges: Ranges, lo: Timestamp, hi: Timestamp):
+def _walk_decided(node, ranges: Ranges, lo: Timestamp, hi: Timestamp,
+                  owned: Ranges = None):
     """Yield (txn_id, cls, at) once per transaction across the node's
     stores (a multi-key command registered in several stores must
-    contribute ONE leaf, or XOR folds would cancel pairwise)."""
+    contribute ONE leaf, or XOR folds would cancel pairwise).  `owned`
+    engages the worker-runtime min-token filter (_owns_min_token) so the
+    same dedup holds across per-shard processes."""
     seen = set()
     for store in node.command_stores.all():
         for txn_id, cmd in list(store.commands.items()):
@@ -141,7 +167,11 @@ def _walk_decided(node, ranges: Ranges, lo: Timestamp, hi: Timestamp):
             ec = entry_class(cmd)
             if ec is None:
                 continue
-            if not _in_ranges(_audit_scope(cmd), ranges):
+            scope = _audit_scope(cmd)
+            if owned is not None:
+                if not _owns_min_token(owned, scope, ranges):
+                    continue
+            elif not _in_ranges(scope, ranges):
                 continue
             seen.add(txn_id)
             yield txn_id, ec[0], ec[1]
@@ -159,18 +189,21 @@ def _walk_decided(node, ranges: Ranges, lo: Timestamp, hi: Timestamp):
             ec = m[0]
             if ec is None:
                 continue
-            if not _in_ranges(m[1], ranges):
+            if owned is not None:
+                if not _owns_min_token(owned, m[1], ranges):
+                    continue
+            elif not _in_ranges(m[1], ranges):
                 continue
             seen.add(txn_id)
             yield txn_id, ec[0], ec[1]
 
 
-def digest_node(node, ranges: Ranges, lo: Timestamp, hi: Timestamp
-                ) -> Tuple[int, int]:
+def digest_node(node, ranges: Ranges, lo: Timestamp, hi: Timestamp,
+                owned: Ranges = None) -> Tuple[int, int]:
     """(digest, count): XOR-fold the committed decisions in the window."""
     acc = 0
     count = 0
-    for txn_id, cls, at in _walk_decided(node, ranges, lo, hi):
+    for txn_id, cls, at in _walk_decided(node, ranges, lo, hi, owned=owned):
         if cls != "committed":
             continue
         acc ^= entry_leaf(txn_id, at)
@@ -178,22 +211,48 @@ def digest_node(node, ranges: Ranges, lo: Timestamp, hi: Timestamp
     return acc, count
 
 
-def digest_reply(node, ranges: Ranges, lo: Timestamp, hi: Timestamp
-                 ) -> AuditDigestOk:
+def digest_reply(node, ranges: Ranges, lo: Timestamp, hi: Timestamp,
+                 owned: Ranges = None) -> AuditDigestOk:
     """Serve one AUDIT_DIGEST_REQ: digest over the REQUESTED window plus
     this replica's own floors for the negotiation."""
-    acc, count = digest_node(node, ranges, lo, hi)
+    acc, count = digest_node(node, ranges, lo, hi, owned=owned)
     flo, fhi = node_floors(node, ranges)
     return AuditDigestOk(f"{acc:032x}", count, flo, fhi)
 
 
-def collect_entries(node, ranges: Ranges, lo: Timestamp, hi: Timestamp
-                    ) -> List[tuple]:
+def collect_entries(node, ranges: Ranges, lo: Timestamp, hi: Timestamp,
+                    owned: Ranges = None) -> List[tuple]:
     """Drill-down entry list for the window, sorted by txn id."""
     out = [(txn_id, cls, at)
-           for txn_id, cls, at in _walk_decided(node, ranges, lo, hi)]
+           for txn_id, cls, at in _walk_decided(node, ranges, lo, hi,
+                                                owned=owned)]
     out.sort(key=lambda e: e[0])
     return out
+
+
+def local_digest(node, ranges: Ranges, lo: Timestamp, hi: Timestamp,
+                 done: Callable) -> None:
+    """Serve the auditor's LOCAL digest leg, calling done(AuditDigestOk).
+    Synchronous in-loop; under the worker runtime the walk fans over the
+    shard pipes (supervisor merge) and `done` fires when they answer."""
+    cs = node.command_stores
+    if cs.remote:
+        from accord_tpu.messages.audit import AuditDigest
+        cs.audit_local(AuditDigest(ranges, lo, hi), done)
+        return
+    done(digest_reply(node, ranges, lo, hi))
+
+
+def local_entries(node, ranges: Ranges, lo: Timestamp, hi: Timestamp,
+                  done: Callable) -> None:
+    """Serve the auditor's LOCAL entry-list leg, calling
+    done(AuditEntriesOk); worker-aware like local_digest."""
+    cs = node.command_stores
+    if cs.remote:
+        from accord_tpu.messages.audit import AuditEntries
+        cs.audit_local(AuditEntries(ranges, lo, hi), done)
+        return
+    done(AuditEntriesOk(tuple(collect_entries(node, ranges, lo, hi))))
 
 
 def _midpoint(lo: Timestamp, hi: Timestamp) -> Optional[Timestamp]:
@@ -275,6 +334,15 @@ def census_node(node, byte_sample: int = 48) -> dict:
     from a bounded sample of canonical encodings (the sweep must stay
     inside the always-on <2% budget, tests/test_obs_budget.py)."""
 
+    cs = node.command_stores
+    if cs.remote:
+        # worker runtime: the stores live in per-shard processes — fold the
+        # cached worker censuses (stats poll, ~2s fresh); before the first
+        # poll lands, fall through to the storeless walk (a zeroed census)
+        merged = cs.merged_census()
+        if merged is not None:
+            return merged
+
     now_us = node.obs.now_us()
     by_class: Dict[str, int] = {}
     by_durability: Dict[str, int] = {}
@@ -292,6 +360,9 @@ def census_node(node, byte_sample: int = 48) -> dict:
     spilled_uncleaned = 0
     cfk_spilled = 0
     paging = None
+    # per-store breakdown (store.id == shard index node-wide): the paging
+    # budget satellite's shard-labeled accord_pager_*/tier gauges read this
+    per_shard: Dict[int, dict] = {}
     floors = {k: None for k in _WATERMARK_KINDS}
     for store in node.command_stores.all():
         # the paging tier: spilled state is evicted, NOT leaked — it must
@@ -328,6 +399,11 @@ def census_node(node, byte_sample: int = 48) -> dict:
                 cur = floors[kind]
                 floors[kind] = wm if cur is None else min(cur, wm)
         n = len(store.commands)
+        per_shard[store.id] = {
+            "resident": n,
+            "spilled": len(pager.meta) if pager is not None else 0,
+            "paging": dict(pager.stats()) if pager is not None else None,
+        }
         stride = max(1, n // max(1, byte_sample))
         for i, cmd in enumerate(list(store.commands.values())):
             total += 1
@@ -382,7 +458,71 @@ def census_node(node, byte_sample: int = 48) -> dict:
         "gated": gated,
         "range_commands": range_cmds,
         "watermarks": watermarks,
+        "per_shard": per_shard,
     }
+
+
+def _merge_int_dicts(acc: Dict[str, int], d: Optional[Dict[str, int]]
+                     ) -> Dict[str, int]:
+    for k, v in (d or {}).items():
+        acc[k] = acc.get(k, 0) + v
+    return acc
+
+
+def merge_censuses(censuses: List[dict], node_id: int, at_us: int) -> dict:
+    """Fold per-worker censuses into one node view (worker runtime).
+    Counts are exact sums; age quantiles cannot be merged exactly, so each
+    is the max across workers (a conservative upper bound); watermark
+    floors take the weakest shard (min hlc / max lag — a floor is only as
+    good as the shard furthest behind)."""
+    out = {
+        "node": node_id, "at_us": at_us,
+        "resident": sum(c["resident"] for c in censuses),
+        "by_class": {}, "by_durability": {},
+        "quiescent_uncleaned": sum(c["quiescent_uncleaned"]
+                                   for c in censuses),
+        "resident_bytes_est": sum(c["resident_bytes_est"]
+                                  for c in censuses),
+        "spilled": sum(c["spilled"] for c in censuses),
+        "spilled_by_class": {},
+        "spilled_quiescent_uncleaned": sum(
+            c["spilled_quiescent_uncleaned"] for c in censuses),
+        "paging": None,
+        "gated": sum(c["gated"] for c in censuses),
+        "range_commands": sum(c["range_commands"] for c in censuses),
+        "per_shard": {},
+    }
+    for c in censuses:
+        _merge_int_dicts(out["by_class"], c["by_class"])
+        _merge_int_dicts(out["by_durability"], c["by_durability"])
+        _merge_int_dicts(out["spilled_by_class"], c["spilled_by_class"])
+        if c.get("paging") is not None:
+            if out["paging"] is None:
+                out["paging"] = {}
+            _merge_int_dicts(out["paging"], c["paging"])
+        for sid, ps in (c.get("per_shard") or {}).items():
+            out["per_shard"][sid] = ps
+    out["age_us"] = {
+        q: max((c["age_us"][q] for c in censuses), default=0)
+        for q in ("p50", "p95", "max")}
+    out["age_us"]["count"] = sum(c["age_us"]["count"] for c in censuses)
+    out["cfk"] = {
+        k: sum(c["cfk"][k] for c in censuses)
+        for k in ("keys", "entries", "spilled")}
+    watermarks: Dict[str, dict] = {}
+    for kind in _WATERMARK_KINDS:
+        wms = [c["watermarks"][kind] for c in censuses
+               if kind in c.get("watermarks", {})]
+        if not wms:
+            watermarks[kind] = {"hlc": 0, "lag_us": -1}
+            continue
+        watermarks[kind] = {
+            "hlc": min(w["hlc"] for w in wms),
+            "lag_us": (-1 if any(w["lag_us"] < 0 for w in wms)
+                       else max(w["lag_us"] for w in wms)),
+        }
+    out["watermarks"] = watermarks
+    return out
 
 
 # --------------------------------------------------------------- auditor --
@@ -413,15 +553,26 @@ class _ShardAudit:
     # -- generic fan-out of one request to every replica (self served
     # locally: no loopback round trip, and an rf=1 shard still audits) --
     def _fan(self, make_req, local_fn, on_all) -> None:
+        # `local_fn(done)` serves the local leg and calls done(reply):
+        # synchronous in-loop, but asynchronous under the worker runtime
+        # (the walk fans over the shard pipes before the reply exists)
         node = self.auditor.node
-        replies: Dict[int, object] = {node.id: local_fn()}
-        missing = [0]  # failed/timed-out peers
-        outstanding = [len(self.peers)]
+        replies: Dict[int, object] = {}
+        missing = [0]  # failed/timed-out replicas (self included)
+        outstanding = [len(self.peers) + 1]
         self.rounds += 1
 
         def settle():
             if outstanding[0] == 0:
                 on_all(replies, missing[0])
+
+        def local_done(reply):
+            if type(reply) in (AuditDigestOk, AuditEntriesOk):
+                replies[node.id] = reply
+            else:
+                missing[0] += 1
+            outstanding[0] -= 1
+            settle()
 
         def ok(from_id, reply):
             if type(reply) in (AuditDigestOk, AuditEntriesOk):
@@ -438,7 +589,7 @@ class _ShardAudit:
 
         for to in self.peers:
             node.send(to, make_req(), FunctionCallback(ok, fail))
-        settle()  # rf=1: no peers, resolve immediately
+        local_fn(local_done)
 
     def _finish(self, outcome: str) -> None:
         if self._settled:
@@ -463,7 +614,7 @@ class _ShardAudit:
                       retries: int) -> None:
         node = self.auditor.node
         self._fan(lambda: AuditDigest(self.ranges, lo, hi),
-                  lambda: digest_reply(node, self.ranges, lo, hi),
+                  lambda done: local_digest(node, self.ranges, lo, hi, done),
                   lambda replies, missing: self._on_digests(
                       lo, hi, retries, replies, missing))
 
@@ -512,25 +663,23 @@ class _ShardAudit:
 
         def try_right():
             self._fan(lambda: AuditDigest(self.ranges, mid, hi),
-                      lambda: digest_reply(node, self.ranges, mid, hi),
+                      lambda done: local_digest(node, self.ranges, mid, hi,
+                                                done),
                       on_half(mid, hi,
                               lambda: self._finish("inconclusive")))
 
         # lowest mismatching half first: the drill lands on the FIRST
         # divergent transaction in the window
         self._fan(lambda: AuditDigest(self.ranges, lo, mid),
-                  lambda: digest_reply(node, self.ranges, lo, mid),
+                  lambda done: local_digest(node, self.ranges, lo, mid,
+                                            done),
                   on_half(lo, mid, try_right))
 
     def _fetch_entries(self, lo, hi, depth) -> None:
         node = self.auditor.node
-
-        def local():
-            return AuditEntriesOk(tuple(collect_entries(
-                node, self.ranges, lo, hi)))
-
         self._fan(lambda: AuditEntries(self.ranges, lo, hi),
-                  local,
+                  lambda done: local_entries(node, self.ranges, lo, hi,
+                                             done),
                   lambda replies, missing: self._on_entries(
                       lo, hi, depth, replies, missing))
 
@@ -717,6 +866,21 @@ class Auditor:
                       "cfk_evictions", "cfk_restores", "spill_disk_bytes",
                       "spill_compactions"):
                 reg.gauge(f"accord_pager_{k}", node=nid).set(paging[k])
+        # per-shard paging budgets: the same tier/pager surfaces labeled by
+        # shard (store.id == shard index, in-loop and worker mode alike)
+        for sid, ps in (census.get("per_shard") or {}).items():
+            reg.gauge("accord_census_commands", node=nid, tier="resident",
+                      shard=sid).set(ps["resident"])
+            reg.gauge("accord_census_commands", node=nid, tier="spilled",
+                      shard=sid).set(ps["spilled"])
+            pg = ps.get("paging")
+            if pg is not None:
+                for k in ("hits", "misses", "evictions", "refaults",
+                          "resident", "resident_high_water", "spilled",
+                          "cfk_evictions", "cfk_restores",
+                          "spill_disk_bytes", "spill_compactions"):
+                    reg.gauge(f"accord_pager_{k}", node=nid,
+                              shard=sid).set(pg.get(k, 0))
         for d, n in census["by_durability"].items():
             reg.gauge("accord_census_resident_by_durability", node=nid,
                       durability=d).set(n)

@@ -15,7 +15,7 @@ from accord_tpu.api.spi import (
     Agent, EventsListener, LocalConfig, MessageSink, ProgressLog, Scheduler,
 )
 from accord_tpu.coordinate.errors import Timeout
-from accord_tpu.local.store import CommandStores, PreLoadContext
+from accord_tpu.local.store import CommandStores, EmptyFanout, PreLoadContext
 from accord_tpu.obs.spans import trace_key as _trace_key
 from accord_tpu.messages.base import Callback, FailureReply, Reply, Request, TxnRequest
 from accord_tpu.primitives.keys import Keys, Ranges, Route, RoutingKey
@@ -115,6 +115,10 @@ class Node:
             self.obs._clock_us = self._now_us
             self.obs.flight._clock_us = self._now_us
         self._hlc = 0
+        # (stripe, mod) congruence class for minted HLCs, or None: set only
+        # by the shard worker runtime (set_hlc_stripe) — in-loop nodes mint
+        # exactly as before
+        self._hlc_stripe = None
         # optional side-effecting-message journal (sim/journal.Journal);
         # when set, every has_side_effects request is recorded at processing
         self.journal = None
@@ -310,13 +314,26 @@ class Node:
 
     def unique_now(self) -> Timestamp:
         """Monotonic unique HLC (Node.uniqueNow CAS loop, :341-366)."""
-        self._hlc = max(self._hlc + 1, self._now_us())
+        self._hlc = self._striped(max(self._hlc + 1, self._now_us()))
         return Timestamp(self.epoch, self._hlc, 0, self.id)
 
     def unique_now_at_least(self, at_least: Timestamp) -> Timestamp:
-        self._hlc = max(self._hlc + 1, self._now_us(), at_least.hlc + 1)
+        self._hlc = self._striped(
+            max(self._hlc + 1, self._now_us(), at_least.hlc + 1))
         epoch = max(self.epoch, at_least.epoch)
         return Timestamp(epoch, self._hlc, 0, self.id)
+
+    def set_hlc_stripe(self, stripe: int, mod: int) -> None:
+        """Worker runtime (shard/): N processes mint under ONE node id, so
+        each confines its HLCs to a congruence class — same-id collisions
+        become impossible without any cross-process clock coordination."""
+        self._hlc_stripe = (stripe, mod)
+
+    def _striped(self, hlc: int) -> int:
+        if self._hlc_stripe is None:
+            return hlc
+        s, m = self._hlc_stripe
+        return hlc + ((s - hlc) % m)
 
     def on_remote_timestamp(self, ts: Timestamp) -> None:
         """Merge a remote HLC observation (epoch/hlc propagation)."""
@@ -618,7 +635,13 @@ class Node:
             # stitch this replica into the transaction's cross-node span
             self.obs.rx(tid, verb, from_id)
         if self.journal is not None and request.type is not None \
-                and request.type.has_side_effects:
+                and request.type.has_side_effects \
+                and not (self.command_stores.remote
+                         and isinstance(request, TxnRequest)):
+            # journal-where-processed: under the shard worker runtime a
+            # TxnRequest's side effects land in a WORKER's stores, and the
+            # worker appends it to its own WAL band before executing — the
+            # parent journaling it too would double-replay on restart
             self.journal.record(self.id, request)
         # protocol-CPU attribution (obs/cpuprof.py, ACCORD_CPU_PROFILE=N):
         # bracket the dispatch so its wall time decomposes into the
@@ -640,7 +663,9 @@ class Node:
     def local_request(self, request: Request) -> None:
         """Apply a local-only request (PROPAGATE_*) to our own stores."""
         if self.journal is not None and request.type is not None \
-                and request.type.has_side_effects:
+                and request.type.has_side_effects \
+                and not (self.command_stores.remote
+                         and isinstance(request, TxnRequest)):
             self.journal.record(self.id, request)
         request.process(self, self.id, None)
 
@@ -649,57 +674,21 @@ class Node:
                                  reply_context) -> None:
         """Fan a TxnRequest out over intersecting command stores, reduce the
         replies (async-aware), reply to the sender
-        (Node.mapReduceConsumeLocal :405 -> CommandStores.mapReduceConsume)."""
-        participants = request.participants()
-        probe = request.deps_probe()
-        rprobe = request.recovery_probe()
-        xprobe = request.execute_probe()
-        context = PreLoadContext.for_txn(
-            request.txn_id, deps_probes=(probe,) if probe is not None else (),
-            recovery_probes=(rprobe,) if rprobe is not None else (),
-            execute_probes=(xprobe,) if xprobe is not None else ())
-        stores = self.command_stores.intersecting(participants)
-        if not stores:
-            if reply_context is not None:
-                self.reply(from_id, reply_context,
-                           FailureReply(RuntimeError("no intersecting store")))
-            return
-        if len(stores) == 1:
-            raw = stores[0].submit(context, request.apply)
-            if raw._done and raw._failure is None \
-                    and not isinstance(raw._value, AsyncResult):
-                # synchronous single-shard dispatch (the host-tier common
-                # case): the reply is already in hand — skip the
-                # flatten/all_of chain machinery entirely
-                if reply_context is not None:
-                    self.reply(from_id, reply_context, raw._value)
-                return
-            pending: List[AsyncResult] = [_flatten(raw)]
-        else:
-            pending = [_flatten(s.submit(context, request.apply))
-                       for s in stores]
-        from accord_tpu.utils import async_chains
+        (Node.mapReduceConsumeLocal :405 -> CommandStores.mapReduceConsume).
+        The fan-out itself lives on CommandStores so the worker runtime
+        (shard/) can route it across per-shard processes unchanged."""
 
-        def finish(values, failure):
+        def consume(value, failure):
             if reply_context is None:
-                if failure is not None:
+                if failure is not None and not isinstance(failure, EmptyFanout):
                     self.agent.on_uncaught_exception(failure)
                 return
             if failure is not None:
                 self.reply(from_id, reply_context, FailureReply(failure))
                 return
-            acc = values[0]
-            for v in values[1:]:
-                acc = request.reduce(acc, v)
-            self.reply(from_id, reply_context, acc)
+            self.reply(from_id, reply_context, value)
 
-        async_chains.all_of(pending).add_callback(finish)
-
-
-def _flatten(result: AsyncResult) -> AsyncResult:
-    """Requests may return a Reply or an AsyncResult[Reply]; flatten."""
-    return result.flat_map(
-        lambda v: v if isinstance(v, AsyncResult) else success(v))
+        self.command_stores.map_reduce_request(request, consume)
 
 
 class _NullProgressLog(ProgressLog):

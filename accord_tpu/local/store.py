@@ -754,10 +754,27 @@ class EvenSplit:
         return out[:self.count]
 
 
+class EmptyFanout(RuntimeError):
+    """A fanned-out request found no intersecting store on this node."""
+
+
+def _flatten_reply(result: AsyncResult) -> AsyncResult:
+    """Requests may return a Reply or an AsyncResult[Reply]; flatten."""
+    from accord_tpu.utils.async_chains import success
+    return result.flat_map(
+        lambda v: v if isinstance(v, AsyncResult) else success(v))
+
+
 class CommandStores:
     """The node's shard manager (CommandStores.java:78): owns N CommandStores
     over an EvenSplit of the node's ranges; fans operations out over
     intersecting shards and chains the reduce."""
+
+    # True on the worker-runtime tier (shard/supervisor.WorkerCommandStores):
+    # stores live in per-shard processes and `all()` has nothing to walk —
+    # callers that need node-wide store folds (audit digests, census) must
+    # go through the supervisor's fan-out instead
+    remote = False
 
     def __init__(self, node, num_shards: int = 1,
                  store_factory: Callable[[int, object, Ranges], CommandStore] = None):
@@ -808,6 +825,63 @@ class CommandStores:
             else:
                 raise TypeError(type(participants))
         return out
+
+    def shard_of(self, participants) -> int:
+        """Index of the first shard a participant set lands on (admission
+        accounting: per-(tenant, shard) QoS buckets key on this)."""
+        for i, s in enumerate(self.stores):
+            if s.ranges.is_empty:
+                continue
+            if isinstance(participants, _SortedKeyList):
+                if participants.intersects_ranges(s.ranges):
+                    return i
+            elif isinstance(participants, Ranges):
+                if s.ranges.intersects(participants):
+                    return i
+        return 0
+
+    def map_reduce_request(self, request, consume) -> None:
+        """Fan a TxnRequest out over intersecting command stores and chain
+        the reduce (CommandStores.mapReduceConsume, :546-640), delivering
+        (value, failure) to `consume` exactly once.  The worker runtime
+        overrides this to ship the same request over per-shard pipes."""
+        participants = request.participants()
+        probe = request.deps_probe()
+        rprobe = request.recovery_probe()
+        xprobe = request.execute_probe()
+        context = PreLoadContext.for_txn(
+            request.txn_id, deps_probes=(probe,) if probe is not None else (),
+            recovery_probes=(rprobe,) if rprobe is not None else (),
+            execute_probes=(xprobe,) if xprobe is not None else ())
+        stores = self.intersecting(participants)
+        if not stores:
+            consume(None, EmptyFanout("no intersecting store"))
+            return
+        if len(stores) == 1:
+            raw = stores[0].submit(context, request.apply)
+            if raw._done and raw._failure is None \
+                    and not isinstance(raw._value, AsyncResult):
+                # synchronous single-shard dispatch (the host-tier common
+                # case): the reply is already in hand — skip the
+                # flatten/all_of chain machinery entirely
+                consume(raw._value, None)
+                return
+            pending: List[AsyncResult] = [_flatten_reply(raw)]
+        else:
+            pending = [_flatten_reply(s.submit(context, request.apply))
+                       for s in stores]
+        from accord_tpu.utils import async_chains
+
+        def finish(values, failure):
+            if failure is not None:
+                consume(None, failure)
+                return
+            acc = values[0]
+            for v in values[1:]:
+                acc = request.reduce(acc, v)
+            consume(acc, None)
+
+        async_chains.all_of(pending).add_callback(finish)
 
     def for_each(self, context: PreLoadContext, participants,
                  fn: Callable[[SafeCommandStore], None]) -> None:

@@ -64,6 +64,11 @@ class AuditDigest(Request):
         self.hi = hi
 
     def process(self, node, from_id: int, reply_context) -> None:
+        if node.command_stores.remote:
+            # worker runtime: the stores live in per-shard processes — fan
+            # the walk out over the worker pipes and merge (supervisor.py)
+            node.command_stores.audit_request(self, from_id, reply_context)
+            return
         from accord_tpu.local import audit as A
         node.reply(from_id, reply_context,
                    A.digest_reply(node, self.ranges, self.lo, self.hi))
@@ -108,6 +113,9 @@ class AuditEntries(Request):
         self.limit = limit if limit is not None else self.LIMIT
 
     def process(self, node, from_id: int, reply_context) -> None:
+        if node.command_stores.remote:
+            node.command_stores.audit_request(self, from_id, reply_context)
+            return
         from accord_tpu.local import audit as A
         entries = A.collect_entries(node, self.ranges, self.lo, self.hi)
         limit = min(self.limit, self.LIMIT)

@@ -129,6 +129,17 @@ EVENT_KINDS = {
     "qos_throttle": "submit throttled by the QoS tier — tenant token "
                     "bucket empty (qos/admission.py); data=(tenant, "
                     "priority, retry_after_us)",
+    "shard_spawn": "shard worker process spawned (or respawned after a "
+                   "crash) by the supervisor (shard/supervisor.py); "
+                   "data=(shard, pid, generation)",
+    "shard_submit": "shard-affine request shipped over a worker pipe "
+                    "(shard/supervisor.py), trace id = the request's; "
+                    "data=(shard, verb)",
+    "shard_reduce": "cross-worker fan-out reduced to one reply "
+                    "(shard/supervisor.py), trace id = the request's; "
+                    "data=(n_shards, verb)",
+    "shard_retire": "shard worker drained and retired "
+                    "(shard/supervisor.py); data=(shard, generation)",
     "geo_install": "geo placement profile installed on this node "
                    "(sim/cluster.py at build; host/tcp.py from ACCORD_GEO "
                    "or an EpochInstall frame); data=(profile_name, dc)",

@@ -67,6 +67,37 @@ def _gauge_total(metrics: dict, name: str) -> int:
     return sum(metrics.get("gauges", {}).get(name, {}).values())
 
 
+def _per_shard_census(metrics: dict) -> Dict[str, dict]:
+    """Cluster-wide per-shard command/pager table from the shard-labeled
+    gauge series (emitted only under the worker runtime — local/audit.py
+    census_once; empty dict when in-loop).  Series WITHOUT a shard label
+    are the node rollups and are deliberately excluded: the rollup and
+    the shard rows would double-count if folded together."""
+    out: Dict[str, dict] = {}
+
+    def row(shard: str) -> dict:
+        return out.setdefault(shard, {"resident": 0, "spilled": 0,
+                                      "pager": {}})
+
+    for lk, v in metrics.get("gauges", {}).get("accord_census_commands",
+                                               {}).items():
+        labels = parse_labels(lk)
+        shard = labels.get("shard", "")
+        tier = labels.get("tier", "")
+        if shard and tier in ("resident", "spilled"):
+            row(shard)[tier] += v
+    for name, series in metrics.get("gauges", {}).items():
+        if not name.startswith("accord_pager_"):
+            continue
+        key = name[len("accord_pager_"):]
+        for lk, v in series.items():
+            shard = parse_labels(lk).get("shard", "")
+            if shard:
+                pg = row(shard)["pager"]
+                pg[key] = pg.get(key, 0) + v
+    return out
+
+
 def _gauge_max_by_label(metrics: dict, name: str, label: str
                         ) -> Dict[str, int]:
     """Worst (max) value of one gauge family grouped by `label`."""
@@ -457,6 +488,9 @@ def summarize(metrics: dict, cpu: Optional[dict] = None) -> dict:
                 metrics, "accord_census_leak_alarms_total"),
             "watermark_lag_us": _gauge_max_by_label(
                 metrics, "accord_watermark_lag_us", "kind"),
+            # worker runtime only: per-shard resident/spilled/pager rows
+            # (shard-labeled series; {} when every node runs in-loop)
+            "per_shard": _per_shard_census(metrics),
         },
         "journal": {
             "appends": _counter_total(metrics,

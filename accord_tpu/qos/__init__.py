@@ -39,13 +39,17 @@ def qos_enabled() -> bool:
 
 
 def qos_tier_from_env(registry, flight, clock_us, loop_health=None,
-                      wal=None, sources=()) -> Optional[QosTier]:
+                      wal=None, sources=(),
+                      n_shards: int = 0) -> Optional[QosTier]:
     """Construct one node's QoS tier from the environment, or None when the
-    gate is off (hosts then keep today's submit path untouched)."""
+    gate is off (hosts then keep today's submit path untouched).
+    `n_shards >= 2` (the worker runtime) arms the per-(tenant, shard)
+    sub-buckets."""
     if not qos_enabled():
         return None
     config = QosConfig.from_env()
     controller = PressureController(config, clock_us,
                                     loop_health=loop_health, wal=wal,
                                     sources=sources)
-    return QosTier(config, registry, flight, clock_us, controller=controller)
+    return QosTier(config, registry, flight, clock_us, controller=controller,
+                   n_shards=n_shards)

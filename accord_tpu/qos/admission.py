@@ -88,9 +88,14 @@ class QosConfig:
                  shed_pressure: float = 1.0, normal_pressure: float = 2.0,
                  lag_target_us: float = 50_000.0, depth_target: float = 128.0,
                  wal_target: int = 256, ewma_half_life_s: float = 0.5,
-                 retry_floor_us: int = 10_000):
+                 retry_floor_us: int = 10_000, shard_factor: float = 2.0):
         self.rate_per_s = max(0.0, rate_per_s)
         self.burst = burst if burst > 0 else max(1.0, self.rate_per_s)
+        # per-shard sub-quota slack under the worker runtime: each
+        # (tenant, shard) bucket gets rate/n * shard_factor, so a skewed
+        # tenant can lean on a hot shard up to factor× its fair share
+        # while the node-level bucket stays the binding total cap
+        self.shard_factor = max(1.0, shard_factor)
         self.shed_pressure = shed_pressure
         self.normal_pressure = max(normal_pressure, shed_pressure)
         self.lag_target_us = max(1.0, lag_target_us)
@@ -117,7 +122,8 @@ class QosConfig:
             lag_target_us=_f("ACCORD_QOS_LAG_TARGET_US", 50_000.0),
             depth_target=_f("ACCORD_QOS_DEPTH_TARGET", 128.0),
             wal_target=int(_f("ACCORD_QOS_WAL_TARGET", 256)),
-            retry_floor_us=int(_f("ACCORD_QOS_RETRY_FLOOR_US", 10_000)))
+            retry_floor_us=int(_f("ACCORD_QOS_RETRY_FLOOR_US", 10_000)),
+            shard_factor=_f("ACCORD_QOS_SHARD_FACTOR", 2.0))
 
     def pressure_limit(self, priority: str) -> float:
         """Shed threshold for a priority class; inf means never
@@ -166,6 +172,11 @@ class TokenBucket:
             return 0.0
         return (1.0 - self.tokens) / self.rate * 1e6
 
+    def refund(self) -> None:
+        """Return one token (a later admission stage refused the op after
+        this bucket had already charged it)."""
+        self.tokens = min(self.burst, self.tokens + 1.0)
+
     def overdraw(self, now_us: int) -> None:
         """Unconditionally spend one token, allowing the bucket to go
         negative (floored at -burst so a surge can starve the bulk tiers
@@ -190,7 +201,8 @@ class QosTier:
     holds exactly — the burn and the slo-overload lane assert it."""
 
     def __init__(self, config: QosConfig, registry, flight, clock_us,
-                 controller: Optional[PressureController] = None):
+                 controller: Optional[PressureController] = None,
+                 n_shards: int = 0):
         self.config = config
         self.registry = registry
         self.flight = flight
@@ -198,6 +210,16 @@ class QosTier:
         self.controller = controller if controller is not None else \
             PressureController(config, clock_us)
         self._buckets: Dict[str, TokenBucket] = {}
+        # per-(tenant, shard) sub-buckets under the worker runtime
+        # (ACCORD_SHARDS >= 2): a tenant hammering ONE worker's keyspace
+        # slice is throttled at factor× its fair share of that shard
+        # before it can queue the whole node quota onto one event loop.
+        # The node-level bucket above stays the binding total cap — a
+        # shard refusal refunds it, so the identity per (tenant,
+        # priority) still balances and no token leaks.
+        self.n_shards = n_shards if n_shards >= 2 else 0
+        self._shard_buckets: Dict[Tuple[str, int], TokenBucket] = {}
+        self._shard_ctrs: Dict[Tuple[str, int], object] = {}
         self._ctrs: Dict[Tuple[str, str, str], object] = {}
         self._g_pressure = registry.gauge("accord_qos_pressure_milli")
         self._g_inflight = registry.gauge("accord_qos_inflight")
@@ -235,8 +257,34 @@ class QosTier:
         floor = self.config.retry_floor_us * max(1.0, pressure)
         return int(min(_RETRY_CAP_US, max(floor, lag_us) + refill_us))
 
-    def admit(self, tenant: str, priority: str) -> Optional[QosRejected]:
-        """One submit's admission decision, before any state is spent."""
+    def _shard_throttle(self, tenant: str, shard: int,
+                        now: int) -> float:
+        """Charge the (tenant, shard) sub-bucket; 0.0 admits, else the
+        refill delay in microseconds.  Lazily built at rate/n × factor —
+        slack for skew, but one shard can never drain the node quota."""
+        key = (tenant, shard)
+        bucket = self._shard_buckets.get(key)
+        if bucket is None:
+            scale = self.config.shard_factor / self.n_shards
+            bucket = TokenBucket(self.config.rate_per_s * scale,
+                                 max(1.0, self.config.burst * scale), now)
+            self._shard_buckets[key] = bucket
+        return bucket.try_take(now)
+
+    def _shard_counter(self, tenant: str, shard: int):
+        key = (tenant, shard)
+        c = self._shard_ctrs.get(key)
+        if c is None:
+            c = self.registry.counter("accord_qos_shard_throttled_total",
+                                      tenant=tenant, shard=shard)
+            self._shard_ctrs[key] = c
+        return c
+
+    def admit(self, tenant: str, priority: str,
+              shard: Optional[int] = None) -> Optional[QosRejected]:
+        """One submit's admission decision, before any state is spent.
+        `shard` (worker runtime only) keys the per-(tenant, shard)
+        sub-bucket; None skips that stage."""
         now = self.clock_us()
         tenant = str(tenant) if tenant else "default"
         if priority not in PRIORITIES:
@@ -285,6 +333,25 @@ class QosTier:
                     f"{self.config.rate_per_s}/s; retry after {retry}us",
                     retry_after_us=retry, tenant=tenant, priority=priority,
                     reason="throttle")
+            if self.n_shards and shard is not None and priority != "high":
+                # shard sub-quota AFTER the node bucket (which stays the
+                # binding cap); high rides its overdraft unthrottled here
+                # too, for the same within-tenant strict-priority reason
+                shard_refill = self._shard_throttle(tenant, shard, now)
+                if shard_refill > 0:
+                    bucket.refund()  # the node token was provisional
+                    retry = self._retry_after_us(now, shard_refill,
+                                                 pressure=pressure)
+                    self._counter("throttled", tenant, priority).inc()
+                    self._shard_counter(tenant, shard).inc()
+                    if self.flight is not None:
+                        self.flight.record("qos_throttle", None,
+                                           (tenant, priority, retry, shard))
+                    return QosRejected(
+                        f"qos throttle: tenant {tenant} over shard {shard} "
+                        f"sub-quota; retry after {retry}us",
+                        retry_after_us=retry, tenant=tenant,
+                        priority=priority, reason="throttle")
         self._counter("admitted", tenant, priority).inc()
         self.inflight += 1
         self._g_inflight.value = self.inflight

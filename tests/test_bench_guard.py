@@ -144,10 +144,12 @@ def test_audit_lane_guard_dry_run_parses_history():
 # ------------------------------------ multicore + coalescing (ISSUE 8) --
 
 def test_multicore_lane_guard_dry_run_parses_history():
-    """The multi-core event-loop scaling lane must stay guard-parseable,
-    and its recorded row must carry the per-process-count scaling table
-    (per-node throughput IS the lane's point) plus the box's core count
-    so a future multi-core box re-baselines knowingly."""
+    """The multi-core sharding lane must stay guard-parseable, and its
+    recorded row must carry the per-shard-count scaling table (one node,
+    ACCORD_SHARDS swept — the scaling curve IS the lane's point) plus
+    the box's core count so a future multi-core box re-baselines
+    knowingly.  The "1" row is the in-loop tier, the non-regression
+    anchor vs the tcp lane."""
     proc = _run(["--config", "multicore", "--guard", "--dry-run"])
     assert proc.returncode == 0, proc.stderr
     row = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -158,11 +160,13 @@ def test_multicore_lane_guard_dry_run_parses_history():
                              "BENCH_HISTORY.json"))))
     entry = hist["multicore"]["host"]
     assert entry["cpus_available"] >= 1
-    table = entry["per_procs"]
+    table = entry["per_shards"]
     assert set(table) >= {"1", "4"}
+    assert table["1"]["tier"] == "in-loop"
+    assert table["4"]["tier"] == "workers"
     for stats in table.values():
         assert stats["aggregate_txn_per_s"] > 0
-        assert stats["per_node_txn_per_s"] > 0
+        assert stats["acked"] > 0
 
 
 def test_tcp_row_carries_coalescing_obs():

@@ -1,0 +1,335 @@
+"""Per-shard worker runtime (accord_tpu/shard/): parity + crash nemesis.
+
+Fast tier pins the gate arithmetic, the in-loop bit-identical wiring
+(`ACCORD_SHARDS` unset -> the PLAIN CommandStores class, no supervisor,
+no shard flight kinds), the per-(tenant, shard) QoS sub-buckets, and the
+census merge fold.
+
+The slow tier drives real worker processes:
+
+  * differential parity — the SAME seeded workload against an in-loop
+    cluster and an ACCORD_SHARDS=2 cluster must produce identical final
+    histories per key, and the sharded cluster's cross-replica audit
+    (whose digests are merged across workers with the min-token ownership
+    filter) must report zero divergences;
+  * crash nemesis — SIGKILL one worker mid-run: the supervisor respawns
+    it (generation bumps on the "shards" admin frame), and every
+    PREVIOUSLY ACKED write is still readable afterwards (journal-where-
+    processed: the worker's WAL band replays before its ShardHello, and
+    pending submits re-ship) — zero lost acks.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+
+# ----------------------------------------------------------- fast tier --
+
+def test_workers_from_env_gate(monkeypatch):
+    """ACCORD_SHARDS unset / 1 / garbage means NO worker runtime."""
+    from accord_tpu.shard import workers_from_env
+    monkeypatch.delenv("ACCORD_SHARDS", raising=False)
+    assert workers_from_env() == 0
+    for raw, want in (("0", 0), ("1", 0), ("2", 2), ("4", 4),
+                      ("nope", 0), ("-3", 0)):
+        monkeypatch.setenv("ACCORD_SHARDS", raw)
+        assert workers_from_env() == want, raw
+
+
+def test_inloop_mode_is_bit_identical_wiring(monkeypatch):
+    """With ACCORD_SHARDS unset the host's command stores are the PLAIN
+    in-loop CommandStores class — not a subclass, no supervisor object,
+    no worker processes — so every pre-shard code path is byte-for-byte
+    untouched (the differential burn's precondition)."""
+    from accord_tpu.host.tcp import TcpHost
+    from accord_tpu.local.store import CommandStores
+    monkeypatch.delenv("ACCORD_SHARDS", raising=False)
+    h = TcpHost(1, {1: ("127.0.0.1", 0)}, rf=1, n_shards=4)
+    try:
+        assert type(h.node.command_stores) is CommandStores
+        assert h.shard_supervisor is None
+        assert not h.node.command_stores.remote
+        r = h.submit([7], {7: 1}).wait(10.0)
+        assert r.failure is None
+        kinds = {e[2] for e in h.node.obs.flight.tail(500)}
+        assert not any(k.startswith("shard_") for k in kinds), kinds
+    finally:
+        h.close()
+
+
+def test_qos_per_shard_buckets(monkeypatch):
+    """Per-(tenant, shard) sub-quota: a tenant hammering one shard is
+    throttled at shard_factor x fair-share, other shards stay open, the
+    refused op's node token is refunded, high overdraws past it, and the
+    node bucket stays the binding total cap."""
+    from accord_tpu.obs.registry import Registry
+    from accord_tpu.qos.admission import QosConfig, QosTier
+
+    t = [0]
+    cfg = QosConfig(rate_per_s=10.0, burst=4.0, shard_factor=2.0)
+    tier = QosTier(cfg, Registry(), None, lambda: t[0], n_shards=4)
+    # shard bucket: rate 5/s, burst max(1, 4 * 2/4) = 2
+    outcomes = [tier.admit("a", "normal", shard=0) for _ in range(4)]
+    assert [o is None for o in outcomes] == [True, True, False, False]
+    assert "shard 0" in str(outcomes[2])
+    assert outcomes[2].reason == "throttle"
+    # the two refusals refunded the node bucket: other shards still admit
+    assert tier.admit("a", "normal", shard=1) is None
+    # high is never shard-throttled (within-tenant strict priority)
+    assert tier.admit("a", "high", shard=0) is None
+    # node bucket remains the binding cap once drained
+    while tier.admit("a", "normal") is None:
+        pass
+    r = tier.admit("a", "normal", shard=1)
+    assert r is not None and "shard" not in str(r)
+    # shard-labeled accounting series exists
+    snap = tier.registry.snapshot()
+    assert snap["counters"]["accord_qos_shard_throttled_total"]
+
+
+def test_qos_shard_stage_off_when_single_shard():
+    """n_shards < 2 (in-loop) leaves the shard stage unarmed even when a
+    shard index is passed — sub-buckets are a worker-runtime concept."""
+    from accord_tpu.obs.registry import Registry
+    from accord_tpu.qos.admission import QosConfig, QosTier
+
+    cfg = QosConfig(rate_per_s=2.0, burst=2.0)
+    tier = QosTier(cfg, Registry(), None, lambda: 0, n_shards=1)
+    assert tier.n_shards == 0
+    assert tier.admit("a", "normal", shard=0) is None
+    assert tier.admit("a", "normal", shard=0) is None
+    r = tier.admit("a", "normal", shard=0)  # node bucket, not shard
+    assert r is not None and "shard" not in str(r)
+
+
+def test_merge_censuses_folds_counts_and_watermarks():
+    """The supervisor's census fold: exact counts sum, age quantiles take
+    the conservative max, watermarks take min-hlc/max-lag with -1 (never
+    negotiated) poisoning, and per_shard rows union."""
+    from accord_tpu.local.audit import merge_censuses
+
+    def census(shard, resident, by_class, p50, max_age, wm):
+        return {
+            "node": 1, "at_us": 0, "resident": resident,
+            "by_class": by_class, "by_durability": {},
+            "quiescent_uncleaned": 0, "resident_bytes_est": 100,
+            "spilled": shard, "spilled_by_class": {},
+            "spilled_quiescent_uncleaned": 0, "paging": None,
+            "age_us": {"p50": p50, "p95": p50, "max": max_age,
+                       "count": resident},
+            "cfk": {"keys": 1, "entries": 2, "spilled": 0},
+            "gated": 0, "range_commands": 0, "watermarks": wm,
+            "per_shard": {shard: {"resident": resident, "spilled": shard,
+                                  "paging": None}},
+        }
+
+    a = census(0, 3, {"applied": 3}, p50=10, max_age=40,
+               wm={"durable_universal": {"hlc": 100, "lag_us": 5},
+                   "durable_majority": {"hlc": 60, "lag_us": 2}})
+    b = census(1, 2, {"applied": 1, "stable": 1}, p50=30, max_age=20,
+               wm={"durable_universal": {"hlc": 80, "lag_us": 9},
+                   "durable_majority": {"hlc": 50, "lag_us": -1}})
+    m = merge_censuses([a, b], node_id=1, at_us=1000)
+    assert m["resident"] == 5 and m["spilled"] == 1
+    assert m["by_class"] == {"applied": 4, "stable": 1}
+    assert m["age_us"]["count"] == 5
+    assert m["age_us"]["p50"] == 30 and m["age_us"]["max"] == 40
+    # min hlc (most conservative), max lag; -1 lag poisons the merge
+    assert m["watermarks"]["durable_universal"] == {"hlc": 80, "lag_us": 9}
+    assert m["watermarks"]["durable_majority"]["lag_us"] == -1
+    assert set(m["per_shard"]) == {0, 1}
+
+
+def test_report_per_shard_census_table():
+    """obs/report: shard-labeled census/pager series fold into the
+    per-shard table; unlabeled node rollups are excluded (they would
+    double-count the same commands)."""
+    from accord_tpu.obs.report import _per_shard_census
+
+    metrics = {"gauges": {
+        "accord_census_commands": {
+            "node=1,shard=0,tier=resident": 5,
+            "node=1,shard=1,tier=resident": 2,
+            "node=1,shard=0,tier=spilled": 1,
+            "node=2,shard=0,tier=resident": 3,
+            "node=1,tier=resident": 99,  # rollup: excluded
+        },
+        "accord_pager_hits": {"node=1,shard=0": 7, "node=1": 50},
+        "accord_pager_resident": {"node=1,shard=1": 4},
+    }}
+    tbl = _per_shard_census(metrics)
+    assert tbl["0"]["resident"] == 8 and tbl["0"]["spilled"] == 1
+    assert tbl["1"]["resident"] == 2
+    assert tbl["0"]["pager"] == {"hits": 7}
+    assert tbl["1"]["pager"] == {"resident": 4}
+
+
+# ----------------------------------------------------------- slow tier --
+
+class _TransientNack(AssertionError):
+    """A submit was nacked (coordination timeout under CPU contention).
+    The append may still have applied, so the run can't be resumed —
+    callers retry the whole mode on a FRESH cluster instead."""
+
+
+def _drain_replies(client, want: int, timeout_s: float = 60.0) -> dict:
+    """Collect `want` submit replies keyed by req id; all must be ok."""
+    got = {}
+    deadline = time.time() + timeout_s
+    while len(got) < want and time.time() < deadline:
+        m = client.recv(timeout_s=5.0)
+        if m and m["body"].get("type") == "submit_reply":
+            body = m["body"]
+            if not body["ok"]:
+                raise _TransientNack(str(body))
+            got[body["req"]] = body
+    assert len(got) == want, f"only {len(got)}/{want} replies"
+    return got
+
+
+def _workload(client, tokens, appends_per_token: int):
+    """Deterministic append workload spread over the cluster: one ack
+    awaited per append (sequential — a burst on a 1-core box can hit a
+    coordination timeout, and a timed-out append may still have applied,
+    which would fork the two modes' histories)."""
+    req = 0
+    for rnd in range(appends_per_token):
+        for i, tok in enumerate(tokens):
+            client.submit(1 + (req % 3), [], {tok: rnd * 1000 + i}, req=req)
+            _drain_replies(client, 1)
+            req += 1
+    return req
+
+
+def _final_reads(client, tokens, req0: int) -> dict:
+    """One read txn per token (routed round-robin), keyed by token."""
+    req = req0
+    out = {}
+    for tok in tokens:
+        client.submit(1 + (req % 3), [tok], {}, req=req)
+        req += 1
+        for body in _drain_replies(client, 1).values():
+            for t, vals in body["reads"].items():
+                out[int(t)] = list(vals)
+    return out
+
+
+@pytest.mark.slow
+def test_differential_parity_inloop_vs_workers(monkeypatch):
+    """The SAME workload against an in-loop cluster and a 2-worker-per-
+    node cluster converges to identical per-key histories, and the
+    sharded cluster's cross-replica audit agrees (merged worker digests,
+    zero divergences)."""
+    from accord_tpu.host.tcp import TcpClusterClient
+
+    tokens = [3, 117, 250, 399, 512, 731, 888]
+    finals = {}
+    for mode, shards in (("inloop", None), ("workers", "2")):
+        if shards is None:
+            monkeypatch.delenv("ACCORD_SHARDS", raising=False)
+        else:
+            monkeypatch.setenv("ACCORD_SHARDS", shards)
+        monkeypatch.setenv("ACCORD_AUDIT_S", "2")
+        for attempt in range(3):
+            c = TcpClusterClient(n_nodes=3, n_shards=4)
+            try:
+                try:
+                    n = _workload(c, tokens, appends_per_token=3)
+                    finals[mode] = _final_reads(c, tokens, n)
+                except _TransientNack:
+                    if attempt == 2:
+                        raise
+                    continue  # retry on a fresh cluster, clean history
+                if mode == "workers":
+                    # shards view: every node runs 2 live workers, gen 1
+                    c._send(1, {"type": "shards", "req": 9001})
+                    rows = None
+                    deadline = time.time() + 20
+                    while rows is None and time.time() < deadline:
+                        m = c.recv(timeout_s=5.0)
+                        if m and m["body"].get("type") == "shards_reply":
+                            rows = m["body"]["shards"]
+                    assert rows is not None and len(rows) == 2, rows
+                    assert all(r["live"] for r in rows), rows
+                    # cross-replica audit over merged worker digests: wait
+                    # for a settled round, then require agreement
+                    report = None
+                    deadline = time.time() + 30
+                    while time.time() < deadline:
+                        c._send(2, {"type": "audit", "req": 9002})
+                        m = c.recv(timeout_s=5.0)
+                        view = (m["body"].get("audit")
+                                if m and m["body"].get("type") == "audit_reply"
+                                else None)
+                        if view and view.get("last_report") \
+                                and view["last_report"]["rounds"]:
+                            report = view
+                            outcomes = {r["outcome"] for r
+                                        in view["last_report"]["rounds"]}
+                            if outcomes == {"agree"}:
+                                break
+                        time.sleep(1.0)
+                    assert report is not None, "no audit round completed"
+                    assert not report["divergences"], report["divergences"]
+                    outcomes = {r["outcome"]
+                                for r in report["last_report"]["rounds"]}
+                    assert outcomes == {"agree"}, outcomes
+                break
+            finally:
+                c.close()
+    # every acked append per key in the same order in both modes
+    assert finals["inloop"] == finals["workers"], finals
+
+
+@pytest.mark.slow
+def test_worker_crash_respawn_zero_lost_acks(monkeypatch, tmp_path):
+    """SIGKILL the worker that owns a key's slice after acking writes to
+    it: the supervisor respawns it (generation bumps), the WAL band
+    replays, and every acked write is still readable — zero lost acks."""
+    from accord_tpu.host.tcp import TcpHost, _build_list_txn
+
+    monkeypatch.setenv("ACCORD_SHARDS", "2")
+    monkeypatch.setenv("ACCORD_JOURNAL", str(tmp_path))
+    h = TcpHost(1, {1: ("127.0.0.1", 0)}, rf=1, n_shards=4)
+    try:
+        sup = h.shard_supervisor
+        deadline = time.time() + 30
+        while not all(r["live"] for r in sup.admin_view()) \
+                and time.time() < deadline:
+            time.sleep(0.2)
+        assert all(r["live"] for r in sup.admin_view())
+
+        tok = 5
+        shard = h.node.command_stores.shard_of(_build_list_txn([tok],
+                                                               {}).keys)
+        acked = []
+        for v in range(4):
+            r = h.submit([], {tok: v}).wait(15.0)
+            assert r.failure is None, repr(r.failure)
+            acked.append(v)
+
+        victim = sup.admin_view()[shard]
+        os.kill(victim["pid"], signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            row = sup.admin_view()[shard]
+            if row["generation"] == victim["generation"] + 1 and row["live"]:
+                break
+            time.sleep(0.2)
+        row = sup.admin_view()[shard]
+        assert row["generation"] == victim["generation"] + 1, row
+        assert row["live"], row
+        # the respawn is on the forensics ring
+        spawns = [e for e in h.node.obs.flight.tail(1000)
+                  if e[2] == "shard_spawn" and e[4][0] == shard]
+        assert any(e[4][2] == victim["generation"] + 1 for e in spawns)
+
+        r = h.submit([tok], {}).wait(15.0)
+        assert r.failure is None, repr(r.failure)
+        vals = {k.token: list(v) for k, v in r.value.read_values.items()}
+        assert vals[tok] == acked, (vals, acked)
+    finally:
+        h.close()

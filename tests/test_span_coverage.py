@@ -257,6 +257,21 @@ def test_journal_lifecycle_kinds_are_covered():
             (kind, recorded[kind])
 
 
+def test_shard_kinds_are_covered():
+    """The worker runtime's lifecycle must stay on the forensics ring:
+    every (re)spawn, every pipe-shipped request, every cross-worker
+    reduce, and every retirement.  Pinned as a SET like the journal
+    lifecycle below — the crash nemesis reads shard_spawn generations to
+    prove a respawn happened, so losing a record would blind it."""
+    recorded = _recorded_flight_kinds()
+    for kind in ("shard_spawn", "shard_submit", "shard_reduce",
+                 "shard_retire"):
+        assert kind in EVENT_KINDS, f"{kind} missing from EVENT_KINDS"
+        assert kind in recorded, f"nothing records {kind}"
+        assert any(p.startswith("shard") for p in recorded[kind]), \
+            (kind, recorded[kind])
+
+
 def test_qos_kinds_are_covered():
     """The admission tier's three verdicts — admit, shed, throttle — must
     stay on the forensics ring: shed accounting audits hang off these

@@ -617,3 +617,114 @@ def test_qos_rejected_exception_codec_round_trips():
         assert back.tenant == exc.tenant
         assert back.priority == exc.priority
         assert back.reason == exc.reason
+
+
+def _shard_frames(gen: _Gen):
+    """Synthesized worker-pipe frames (shard/frames.py): every frame class
+    the supervisor<->worker pipes carry, with nested wire-registered
+    payloads where the real runtime nests them (EpochInstall chains on
+    init, audit replies, JSON-safe census/flight snapshots)."""
+    from accord_tpu.messages.admin import EpochInstall
+    from accord_tpu.messages.audit import (AuditDigest, AuditDigestOk,
+                                           AuditEntriesOk)
+    from accord_tpu.shard import frames as sf
+
+    epoch = 2 + gen.rng.next_int(0, 5)
+    mid = 100 + gen.token()
+    install = EpochInstall(epoch, ((0, mid, (1, 2, 3)),
+                                   (mid, 1000, (2, 3, 4))))
+    seq = 1 + gen.rng.next_int(0, 1 << 20)
+    shard = gen.rng.next_int(0, 3)
+    digest_ok = AuditDigestOk(f"{gen.rng.next_int(1 << 30):032x}",
+                              gen.token(), gen.ts(), gen.ts())
+    entries_ok = AuditEntriesOk(
+        ((gen.txn_id(), "committed", gen.ts()),
+         (gen.txn_id(), "invalidated", None)),
+        truncated=gen.rng.next_bool())
+    return [
+        sf.ShardInit(1 + gen.rng.next_int(0, 2), shard, 4, shard + 1, 5,
+                     1 + gen.rng.next_int(0, 3),
+                     installs=(install, EpochInstall(epoch + 1,
+                                                     ((0, 1000, (1, 2)),)))),
+        sf.ShardInit(1, 0, 2, 1, 3, 1),  # empty-chain arm
+        sf.ShardHello(shard, 1000 + gen.token(), 1),
+        sf.ShardEpoch(install),
+        sf.ShardSubmit(seq, AuditDigest(gen.ranges(), gen.ts(), gen.ts())),
+        sf.ShardReply(seq, digest_ok, None),
+        sf.ShardReply(seq, None, "RuntimeError('worker boom')"),
+        sf.ShardReply(seq, None, None),  # EmptyFanout no-op leg
+        sf.ShardSend(None, 1 + gen.rng.next_int(0, 2),
+                     AuditDigest(gen.ranges(), gen.ts(), gen.ts())),
+        sf.ShardSend(seq, 1, AuditDigest(gen.ranges(), gen.ts(), gen.ts())),
+        sf.ShardDeliver(seq, 1 + gen.rng.next_int(0, 2), digest_ok),
+        sf.ShardStatsReq(seq, flight_tail=256),
+        sf.ShardStatsRsp(
+            seq, shard, 1000 + gen.token(), 1,
+            census={"resident": gen.token(), "spilled": 0,
+                    "by_class": {"applied": gen.token()},
+                    "per_shard": {shard: {"resident": gen.token(),
+                                          "spilled": 0, "paging": None}}},
+            paging={"hits": gen.token(), "misses": 0},
+            flight=((gen.token(), seq, "rx", None, (1, "PRE_ACCEPT_REQ")),
+                    (gen.token(), seq + 1, "shard_submit", "t1",
+                     (shard, "APPLY_REQ")))),
+        sf.ShardAudit(seq, "digest", gen.ranges(), gen.ts(), gen.ts()),
+        sf.ShardAudit(seq, "entries", gen.ranges(), gen.ts(), gen.ts(),
+                      limit=64),
+        sf.ShardAuditRsp(seq, digest_ok),
+        sf.ShardAuditRsp(seq, entries_ok),
+        sf.ShardRetire(seq),
+        sf.ShardRetired(seq, shard, 2),
+    ]
+
+
+def test_shard_pipe_frames_round_trip_both_tiers():
+    """Every worker-pipe frame survives exactly the codec path
+    shard/pipe.py drives — pack_frame -> unpack_frame_obj -> decode — with
+    a canonically stable encoding, and the two pack tiers stay
+    byte-identical over them: a py-tier worker must mean the same thing
+    to a native-tier supervisor and vice versa."""
+    from accord_tpu.host import wire
+    from accord_tpu.host.wire import (decode_message, encode_message,
+                                      pack_frame, unpack_frame_obj)
+
+    _, nat_pack, _, _ = _codec_tiers()
+    for frame in _shard_frames(_Gen(51219)):
+        packed = pack_frame(frame)
+        obj = unpack_frame_obj(packed)
+        decoded = decode_message(obj) if type(obj) is dict else obj
+        assert type(decoded) is type(frame), (type(frame), type(decoded))
+        from accord_tpu.journal.snapshot import canonical_encoding
+        assert canonical_encoding(decoded) == canonical_encoding(frame), \
+            f"{type(frame).__name__} encoding not stable across the pipe"
+        if nat_pack is not None:
+            out = bytearray()
+            wire._py_pack_value(encode_message(frame), out)
+            assert nat_pack(encode_message(frame)) == bytes(out), \
+                f"{type(frame).__name__} pack tiers diverge"
+
+
+def test_shard_submit_wraps_every_harvested_request(harvested):
+    """ShardSubmit/ShardReply carrying ORGANIC protocol traffic: one frame
+    per harvested side-effecting request class round-trips through the
+    pipe codec path — the worker journals exactly what it decodes from
+    these, so their fidelity is the shard WAL's durability contract."""
+    from accord_tpu.host.wire import decode_message, pack_frame, unpack_frame_obj
+    from accord_tpu.journal.snapshot import canonical_encoding
+    from accord_tpu.shard import frames as sf
+
+    by_class = {}
+    for m in harvested:
+        if getattr(m, "type", None) is not None \
+                and m.type.name.endswith("_REQ"):
+            by_class.setdefault(type(m).__name__, m)
+    assert len(by_class) > 5
+    for i, msg in enumerate(sorted(by_class.values(),
+                                   key=lambda m: type(m).__name__)):
+        frame = sf.ShardSubmit(i, msg)
+        obj = unpack_frame_obj(pack_frame(frame))
+        decoded = decode_message(obj) if type(obj) is dict else obj
+        assert type(decoded) is sf.ShardSubmit
+        assert type(decoded.request) is type(msg)
+        assert canonical_encoding(decoded.request) \
+            == canonical_encoding(msg), type(msg).__name__
